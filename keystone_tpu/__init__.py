@@ -1,0 +1,41 @@
+"""keystone-tpu: a TPU-native ML pipeline framework.
+
+A ground-up rebuild of the capabilities of KeystoneML (AMPLab's Spark-based
+pipeline system): Transformers and Estimators compose with ``and_then`` into a
+lazily-optimized dataflow DAG, but execution is jax/XLA — fitted pipelines
+compile into a single fused XLA computation, solvers run on HBM-sharded arrays
+with ICI collectives, and featurizers are batched jax/Pallas kernels.
+"""
+
+from .data.dataset import Dataset
+from .workflow import (
+    Chainable,
+    Estimator,
+    FittedPipeline,
+    FunctionNode,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    PipelineDataset,
+    PipelineDatum,
+    PipelineEnv,
+    Transformer,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset",
+    "Chainable",
+    "Pipeline",
+    "PipelineDataset",
+    "PipelineDatum",
+    "PipelineEnv",
+    "FittedPipeline",
+    "Transformer",
+    "Estimator",
+    "LabelEstimator",
+    "FunctionNode",
+    "Identity",
+    "__version__",
+]

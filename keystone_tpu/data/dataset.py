@@ -1,0 +1,129 @@
+"""The host-side logical collection type that stands in for the reference's RDD.
+
+The reference distributes ``RDD[T]`` over Spark executors and gets per-partition
+batching by stacking rows into local matrices (``utils/MatrixUtils.scala:41-77``).
+On TPU the natural layout is the opposite: data lives *already batched* as a
+stacked ``jax.Array`` in HBM (leading batch dimension), optionally sharded over a
+device mesh, and per-item views are the derived form. ``Dataset`` wraps either:
+
+  * ``batched`` payload — one array (or pytree of arrays) with a common leading
+    batch dimension. This is the fast path every numeric node uses.
+  * ``items`` payload — a Python list of arbitrary objects (ragged images,
+    token lists, strings) for data that has no rectangular layout.
+
+Transformers prefer ``map_batch`` over arrays; ``map`` is the per-item fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _is_arraylike(x: Any) -> bool:
+    return isinstance(x, (np.ndarray, jnp.ndarray, jax.Array))
+
+
+class Dataset:
+    """A logical collection of N items, batched (stacked array) or listed."""
+
+    def __init__(self, payload: Any, *, batched: bool):
+        self._payload = payload
+        self._batched = batched
+
+    # ---- constructors ---------------------------------------------------
+
+    @staticmethod
+    def of(data: Any) -> "Dataset":
+        """Wrap ``data``: arrays become batched datasets, iterables item lists."""
+        if isinstance(data, Dataset):
+            return data
+        if _is_arraylike(data):
+            return Dataset(data, batched=True)
+        return Dataset(list(data), batched=False)
+
+    @staticmethod
+    def from_array(arr: Any) -> "Dataset":
+        return Dataset(jnp.asarray(arr), batched=True)
+
+    @staticmethod
+    def from_items(items: Iterable[Any]) -> "Dataset":
+        return Dataset(list(items), batched=False)
+
+    # ---- shape / access -------------------------------------------------
+
+    @property
+    def is_batched(self) -> bool:
+        return self._batched
+
+    @property
+    def payload(self) -> Any:
+        return self._payload
+
+    def __len__(self) -> int:
+        if self._batched:
+            leaves = jax.tree_util.tree_leaves(self._payload)
+            return int(leaves[0].shape[0])
+        return len(self._payload)
+
+    def __iter__(self) -> Iterator[Any]:
+        if self._batched:
+            n = len(self)
+            for i in range(n):
+                yield jax.tree_util.tree_map(lambda a: a[i], self._payload)
+        else:
+            yield from self._payload
+
+    def first(self) -> Any:
+        if self._batched:
+            return jax.tree_util.tree_map(lambda a: a[0], self._payload)
+        return self._payload[0]
+
+    def collect(self) -> List[Any]:
+        """Materialize as a list of per-item values (host)."""
+        return list(self)
+
+    def to_array(self) -> jnp.ndarray:
+        """The stacked-array view; stacks list items if necessary."""
+        if self._batched:
+            return self._payload
+        return jnp.stack([jnp.asarray(x) for x in self._payload])
+
+    # ---- functional ops -------------------------------------------------
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        """Per-item map on the host. Result is re-batched if items are arrays
+        of identical shape."""
+        items = [fn(x) for x in self]
+        return _rebatch(items)
+
+    def map_batch(self, fn: Callable[[Any], Any]) -> "Dataset":
+        """Apply ``fn`` to the whole stacked payload at once (the TPU path)."""
+        return Dataset(fn(self.to_array()), batched=True)
+
+    def zip(self, *others: "Dataset") -> "Dataset":
+        cols = [self, *others]
+        n = len(self)
+        for o in others:
+            if len(o) != n:
+                raise ValueError("zip of datasets with different lengths")
+        return Dataset.from_items(list(zip(*[c.collect() for c in cols])))
+
+    def cache(self) -> "Dataset":
+        """Materialize on device (batched) or as a list; identity semantics."""
+        if self._batched:
+            payload = jax.tree_util.tree_map(jnp.asarray, self._payload)
+            return Dataset(payload, batched=True)
+        return Dataset(list(self._payload), batched=False)
+
+
+def _rebatch(items: Sequence[Any]) -> Dataset:
+    """Stack per-item results back into a batched dataset when rectangular."""
+    if items and all(_is_arraylike(x) for x in items):
+        shape = np.shape(items[0])
+        if all(np.shape(x) == shape for x in items):
+            return Dataset(jnp.stack([jnp.asarray(x) for x in items]), batched=True)
+    return Dataset(list(items), batched=False)

@@ -1,0 +1,3 @@
+from .serialization import load_pickle, save_pickle
+
+__all__ = ["load_pickle", "save_pickle"]

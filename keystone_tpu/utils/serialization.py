@@ -1,0 +1,46 @@
+"""Pickle helpers that survive jax arrays.
+
+``FittedPipeline`` persistence (parity: java serialization of
+``FittedPipeline.scala:12-22``) uses pickle; device arrays are converted to
+numpy on the way out and restored as numpy (jax ops accept numpy inputs and
+re-device-put on first use).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class _JaxAwarePickler(pickle.Pickler):
+    def persistent_id(self, obj: Any):
+        return None
+
+    def reducer_override(self, obj: Any):
+        if isinstance(obj, jax.Array):
+            return (np.asarray, (np.asarray(obj),))
+        return NotImplemented
+
+
+def dumps(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    _JaxAwarePickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(obj)
+    return buf.getvalue()
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def save_pickle(obj: Any, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(dumps(obj))
+
+
+def load_pickle(path: str) -> Any:
+    with open(path, "rb") as f:
+        return loads(f.read())

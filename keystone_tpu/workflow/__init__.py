@@ -1,0 +1,99 @@
+"""Workflow core: the typed pipeline API over an untyped, optimizable DAG."""
+
+from .graph import Graph, GraphError, NodeId, SinkId, SourceId
+from .operators import (
+    Cacheable,
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    ExpressionOperator,
+    GatherTransformerOperator,
+    Operator,
+    TransformerOperator,
+)
+from .expressions import (
+    DatasetExpression,
+    DatumExpression,
+    Expression,
+    TransformerExpression,
+)
+from .env import PipelineEnv
+from .executor import GraphExecutor
+from .pipeline import (
+    Chainable,
+    FittedPipeline,
+    Pipeline,
+    PipelineDataset,
+    PipelineDatum,
+    PipelineResult,
+)
+from .transformer import (
+    Estimator,
+    FunctionNode,
+    Identity,
+    LabelEstimator,
+    Transformer,
+)
+from .node_optimization import Optimizable
+from .optimizers import AutoCachingOptimizer, DefaultOptimizer, Optimizer
+from .prefix import Prefix, find_prefix
+from .rules import (
+    Batch,
+    EquivalentNodeMergeRule,
+    ExtractSaveablePrefixes,
+    Rule,
+    RuleExecutor,
+    SavedStateLoadRule,
+    Strategy,
+    UnusedBranchRemovalRule,
+)
+
+__all__ = [
+    "Graph",
+    "GraphError",
+    "NodeId",
+    "SinkId",
+    "SourceId",
+    "Operator",
+    "Cacheable",
+    "DatasetOperator",
+    "DatumOperator",
+    "DelegatingOperator",
+    "EstimatorOperator",
+    "ExpressionOperator",
+    
+    "GatherTransformerOperator",
+    "TransformerOperator",
+    "Expression",
+    "DatasetExpression",
+    "DatumExpression",
+    "TransformerExpression",
+    "PipelineEnv",
+    "GraphExecutor",
+    "Chainable",
+    "Pipeline",
+    "PipelineResult",
+    "PipelineDataset",
+    "PipelineDatum",
+    "FittedPipeline",
+    "Transformer",
+    "Estimator",
+    "LabelEstimator",
+    "FunctionNode",
+    "Identity",
+    "Optimizable",
+    "Optimizer",
+    "DefaultOptimizer",
+    "AutoCachingOptimizer",
+    "Prefix",
+    "find_prefix",
+    "Rule",
+    "RuleExecutor",
+    "Batch",
+    "Strategy",
+    "EquivalentNodeMergeRule",
+    "UnusedBranchRemovalRule",
+    "ExtractSaveablePrefixes",
+    "SavedStateLoadRule",
+]

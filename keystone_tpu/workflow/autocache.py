@@ -1,0 +1,141 @@
+"""Profile-guided materialization planning (parity: ``workflow/AutoCacheRule.scala``).
+
+In the reference, RDDs are recomputed per action unless a ``Cacher`` node
+persists them, and AutoCacheRule decides which to cache under a memory budget.
+Here the default executor memoizes every node's result in HBM, so the planner's
+job inverts: decide which intermediates are *worth retaining* versus dropping
+and recomputing under HBM pressure. This module currently implements node
+profiling (wall time + result bytes at sample scales) and the greedy
+runs-x-saved-time selection; the eviction hook lands with the materialization
+planner (see ``docs/ROADMAP.md``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..data.dataset import Dataset
+from .executor import GraphExecutor
+from .graph import Graph, NodeId
+from .node_optimization import _sampled_graph
+from .rules import Annotations, Rule
+from . import analysis
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Profile:
+    """Per-node cost estimate (parity: ``AutoCacheRule.scala:12``)."""
+
+    ns: float  # nanoseconds to compute
+    mem_bytes: float  # size of the materialized result
+
+    def __add__(self, other: "Profile") -> "Profile":
+        return Profile(self.ns + other.ns, self.mem_bytes + other.mem_bytes)
+
+
+def _result_bytes(value) -> float:
+    if isinstance(value, Dataset):
+        if value.is_batched:
+            return float(
+                sum(np.prod(a.shape) * a.dtype.itemsize for a in jax.tree_util.tree_leaves(value.payload))
+            )
+        return float(sum(getattr(np.asarray(x), "nbytes", 64) for x in value.collect()))
+    return 64.0
+
+
+def profile_nodes(graph: Graph, sample_size: int = 24) -> Dict[NodeId, Profile]:
+    """Execute a leaf-sampled copy of the graph, timing each node and sizing
+    its result (the reference fits linear scale models over several sample
+    fractions; one sample scale + linear extrapolation is used here)."""
+    sampled = _sampled_graph(graph, sample_size)
+    executor = GraphExecutor(sampled, optimize=False)
+    profiles: Dict[NodeId, Profile] = {}
+    for gid in analysis.linearize(sampled):
+        if not isinstance(gid, NodeId):
+            continue
+        try:
+            t0 = time.perf_counter_ns()
+            value = executor.execute(gid).get()
+            elapsed = time.perf_counter_ns() - t0
+        except Exception as e:
+            logger.debug("profiling skipped %s: %s", gid, e)
+            continue
+        profiles[gid] = Profile(float(elapsed), _result_bytes(value))
+    return profiles
+
+
+def estimate_runs(graph: Graph, weights: Dict[NodeId, int], cached: set) -> Dict[NodeId, int]:
+    """Times each node runs given which nodes are cached: a node reruns once
+    per (weighted) downstream consumer path that is not cut by a cached node
+    (parity: ``AutoCacheRule.getRuns``)."""
+    runs: Dict[NodeId, int] = {}
+
+    def runs_of(gid) -> int:
+        if gid in runs:
+            return runs[gid]
+        children = analysis.get_children(graph, gid)
+        if not children:
+            total = 1
+        else:
+            total = 0
+            for c in children:
+                if isinstance(c, NodeId):
+                    w = weights.get(c, 1)
+                    total += w * (1 if c in cached else runs_of(c))
+                else:  # sink
+                    total += 1
+        runs[gid] = max(total, 1)
+        return runs[gid]
+
+    for n in graph.nodes:
+        runs_of(n)
+    return runs
+
+
+class AutoCacheRule(Rule):
+    """Greedy cache selection under a byte budget; currently selection is
+    advisory (executor memoizes everything) and is logged for inspection."""
+
+    def __init__(self, strategy: str = "greedy", mem_budget_bytes: Optional[int] = None):
+        self.strategy = strategy
+        self.mem_budget_bytes = mem_budget_bytes
+
+    def apply(self, graph: Graph, annotations: Annotations) -> Tuple[Graph, Annotations]:
+        profiles = profile_nodes(graph)
+        weights = {
+            n: getattr(graph.get_operator(n), "weight", 1) for n in graph.nodes
+        }
+        budget = self.mem_budget_bytes or (4 << 30)
+        cached: set = set()
+        if self.strategy == "aggressive":
+            cached = {n for n in graph.nodes if len(analysis.get_children(graph, n)) > 1}
+        else:
+            spent = 0.0
+            while True:
+                runs = estimate_runs(graph, weights, cached)
+                best, best_save = None, 0.0
+                for n, p in profiles.items():
+                    if n in cached or spent + p.mem_bytes > budget:
+                        continue
+                    save = (runs[n] - 1) * p.ns
+                    if save > best_save:
+                        best, best_save = n, save
+                if best is None:
+                    break
+                cached.add(best)
+                spent += profiles[best].mem_bytes
+        if cached:
+            logger.info(
+                "auto-cache: would retain %d nodes (%s)",
+                len(cached),
+                ", ".join(graph.get_operator(n).label for n in sorted(cached)),
+            )
+        return graph, annotations

@@ -1,0 +1,46 @@
+"""Process-global pipeline environment.
+
+Parity target: ``workflow/PipelineEnv.scala`` — holds (a) the prefix → saved
+expression table giving fit-once semantics across pipeline executions, and
+(b) the optimizer used to rewrite graphs before execution. Tests reset it
+between cases exactly like the reference's ``PipelineContext.afterEach``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, TYPE_CHECKING
+
+from .expressions import Expression
+from .prefix import Prefix
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .optimizers import Optimizer
+
+
+class PipelineEnv:
+    _instance: Optional["PipelineEnv"] = None
+
+    def __init__(self) -> None:
+        self.state: Dict[Prefix, Expression] = {}
+        self._optimizer: Optional["Optimizer"] = None
+
+    @classmethod
+    def get_or_create(cls) -> "PipelineEnv":
+        if cls._instance is None:
+            cls._instance = PipelineEnv()
+        return cls._instance
+
+    @property
+    def optimizer(self) -> "Optimizer":
+        if self._optimizer is None:
+            from .optimizers import DefaultOptimizer
+
+            self._optimizer = DefaultOptimizer()
+        return self._optimizer
+
+    def set_optimizer(self, optimizer: "Optimizer") -> None:
+        self._optimizer = optimizer
+
+    def reset(self) -> None:
+        self.state.clear()
+        self._optimizer = None

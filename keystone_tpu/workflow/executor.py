@@ -1,0 +1,54 @@
+"""Pull-based memoized graph executor.
+
+Parity target: ``workflow/GraphExecutor.scala``. The executor optimizes its
+graph lazily on first use, then ``execute(graph_id)`` recursively pulls
+dependency expressions, memoizing one expression per graph id. Results of
+saveable prefixes (annotated by the optimizer) are written into the global
+:class:`PipelineEnv` state so later executions skip the work entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .env import PipelineEnv
+from .expressions import Expression
+from .graph import Graph, GraphId, NodeId, SinkId, SourceId
+from .rules import Annotations
+
+
+class GraphExecutor:
+    def __init__(self, graph: Graph, optimize: bool = True):
+        self._input_graph = graph
+        self._optimize = optimize
+        self._optimized: Optional[Graph] = None
+        self._annotations: Annotations = {}
+        self._state: Dict[GraphId, Expression] = {}
+
+    @property
+    def graph(self) -> Graph:
+        """The optimized graph (optimization happens once, lazily)."""
+        if self._optimized is None:
+            if self._optimize:
+                optimizer = PipelineEnv.get_or_create().optimizer
+                self._optimized, self._annotations = optimizer.execute(self._input_graph)
+            else:
+                self._optimized = self._input_graph
+        return self._optimized
+
+    def execute(self, graph_id: GraphId) -> Expression:
+        graph = self.graph  # force optimization before anything runs
+        if isinstance(graph_id, SourceId):
+            raise ValueError(f"cannot execute unconnected {graph_id}")
+        if isinstance(graph_id, SinkId):
+            return self.execute(graph.get_sink_dependency(graph_id))
+        if graph_id in self._state:
+            return self._state[graph_id]
+        deps = [self.execute(d) for d in graph.get_dependencies(graph_id)]
+        op = graph.get_operator(graph_id)
+        expr = op.execute(deps)
+        self._state[graph_id] = expr
+        prefix = self._annotations.get(graph_id)
+        if prefix is not None:
+            PipelineEnv.get_or_create().state[prefix] = expr
+        return expr

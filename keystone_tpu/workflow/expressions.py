@@ -1,0 +1,77 @@
+"""Lazy, memoized execution results.
+
+Parity target: ``workflow/Expression.scala`` in the reference. An ``Expression``
+wraps a thunk evaluated at most once; laziness is what lets the optimizer
+rewrite the graph before anything executes, and memoization is what makes the
+pull-based executor cheap to re-enter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..data.dataset import Dataset
+    from .operators import TransformerOperator
+
+_UNSET = object()
+
+
+class Expression:
+    """A call-by-name, memoized value."""
+
+    def __init__(self, thunk: Callable[[], Any]):
+        self._thunk = thunk
+        self._value: Any = _UNSET
+
+    @property
+    def computed(self) -> bool:
+        return self._value is not _UNSET
+
+    def get(self) -> Any:
+        if self._value is _UNSET:
+            self._value = self._thunk()
+            self._thunk = None  # release captured state
+        return self._value
+
+    @staticmethod
+    def now(value: Any) -> "Expression":
+        e = Expression(lambda: value)
+        e.get()
+        return e
+
+
+class DatasetExpression(Expression):
+    """Evaluates to a :class:`Dataset`."""
+
+    def get(self) -> "Dataset":
+        return super().get()
+
+    @staticmethod
+    def now(value: "Dataset") -> "DatasetExpression":
+        e = DatasetExpression(lambda: value)
+        e.get()
+        return e
+
+
+class DatumExpression(Expression):
+    """Evaluates to a single datum."""
+
+    @staticmethod
+    def now(value: Any) -> "DatumExpression":
+        e = DatumExpression(lambda: value)
+        e.get()
+        return e
+
+
+class TransformerExpression(Expression):
+    """Evaluates to a fitted :class:`TransformerOperator`."""
+
+    def get(self) -> "TransformerOperator":
+        return super().get()
+
+    @staticmethod
+    def now(value: "TransformerOperator") -> "TransformerExpression":
+        e = TransformerExpression(lambda: value)
+        e.get()
+        return e

@@ -1,0 +1,101 @@
+"""Node-level implementation choice driven by data samples.
+
+Parity target: ``workflow/NodeOptimizationRule.scala`` + ``OptimizableNodes.scala``.
+An ``Optimizable`` node (e.g. the auto-solver ``LeastSquaresEstimator``, the
+PCA chooser) inspects a small sample of its input plus the full dataset size
+and returns the concrete operator to run. The rule executes the DAG on
+sampled leaf datasets to produce those samples, then swaps operators in place.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+
+from ..data.dataset import Dataset
+from .executor import GraphExecutor
+from .graph import Graph, NodeId
+from .operators import DatasetOperator, Operator
+from .rules import Annotations, Rule
+from . import analysis
+
+logger = logging.getLogger(__name__)
+
+#: how many items to sample from each leaf dataset (reference samples
+#: 3/partition across the cluster; a flat count is the equivalent here)
+DEFAULT_SAMPLE_SIZE = 24
+
+
+class Optimizable:
+    """Mixin: a node that can pick its implementation given a data sample.
+
+    ``sample_optimize(samples, num_items)`` receives one sampled ``Dataset``
+    per dependency and the full input size, and returns the replacement
+    operator (often ``self`` configured, or a different node entirely).
+    """
+
+    def sample_optimize(self, samples: Sequence[Dataset], num_items: int) -> Operator:
+        raise NotImplementedError
+
+
+def _sampled_graph(graph: Graph, sample_size: int) -> Graph:
+    for node in graph.nodes:
+        op = graph.get_operator(node)
+        if isinstance(op, DatasetOperator):
+            ds = op.dataset
+            if len(ds) > sample_size:
+                if ds.is_batched:
+                    sampled = Dataset(
+                        jax.tree_util.tree_map(lambda a: a[:sample_size], ds.payload),
+                        batched=True,
+                    )
+                else:
+                    sampled = Dataset.from_items(ds.collect()[:sample_size])
+                graph = graph.set_operator(node, DatasetOperator(sampled))
+    return graph
+
+
+def _total_items(graph: Graph, node: NodeId) -> int:
+    n = 0
+    for anc in analysis.get_ancestors(graph, node) | {node}:
+        if isinstance(anc, NodeId):
+            op = graph.get_operator(anc)
+            if isinstance(op, DatasetOperator):
+                n = max(n, len(op.dataset))
+    return n
+
+
+class NodeOptimizationRule(Rule):
+    def __init__(self, sample_size: int = DEFAULT_SAMPLE_SIZE):
+        self.sample_size = sample_size
+
+    def apply(self, graph: Graph, annotations: Annotations) -> Tuple[Graph, Annotations]:
+        optimizable = [
+            n
+            for n in analysis.linearize(graph)
+            if isinstance(n, NodeId)
+            and n in graph.operators
+            and isinstance(graph.get_operator(n), Optimizable)
+        ]
+        if not optimizable:
+            return graph, annotations
+
+        sampled = _sampled_graph(graph, self.sample_size)
+        executor = GraphExecutor(sampled, optimize=False)
+        for node in optimizable:
+            op = graph.get_operator(node)
+            deps = graph.get_dependencies(node)
+            try:
+                samples = [executor.execute(d).get() for d in deps]
+            except Exception as e:  # estimator upstream of sample path etc.
+                logger.warning("node optimization skipped for %s: %s", op.label, e)
+                continue
+            samples = [s if isinstance(s, Dataset) else Dataset.of([s]) for s in samples]
+            num_items = _total_items(graph, node)
+            chosen = op.sample_optimize(samples, num_items)
+            if chosen is not op:
+                logger.info("node optimization: %s -> %s", op.label, chosen.label)
+                graph = graph.set_operator(node, chosen)
+        return graph, annotations

@@ -1,0 +1,61 @@
+"""Named optimizer rule stacks (parity: ``workflow/DefaultOptimizer.scala``)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .rules import (
+    Batch,
+    EquivalentNodeMergeRule,
+    ExtractSaveablePrefixes,
+    Rule,
+    RuleExecutor,
+    SavedStateLoadRule,
+    Strategy,
+    UnusedBranchRemovalRule,
+)
+
+
+class Optimizer(RuleExecutor):
+    """Base optimizer type registered in :class:`PipelineEnv`."""
+
+
+class DefaultOptimizer(Optimizer):
+    """Load saved state, then CSE, then node-level implementation choice."""
+
+    def batches(self) -> List[Batch]:
+        from .node_optimization import NodeOptimizationRule
+
+        return [
+            Batch(
+                "Load Saved State",
+                Strategy.ONCE,
+                [ExtractSaveablePrefixes(), SavedStateLoadRule(), UnusedBranchRemovalRule()],
+            ),
+            Batch(
+                "Common Sub-expression Elimination",
+                Strategy.FIXED_POINT,
+                [EquivalentNodeMergeRule()],
+            ),
+            Batch("Node Level Optimization", Strategy.ONCE, [NodeOptimizationRule()]),
+        ]
+
+
+class AutoCachingOptimizer(DefaultOptimizer):
+    """DefaultOptimizer plus profile-guided cache/materialization planning
+    (parity: ``DefaultOptimizer.scala:19-26``)."""
+
+    def __init__(self, strategy: str = "greedy", mem_budget_bytes: int = None):
+        self.strategy = strategy
+        self.mem_budget_bytes = mem_budget_bytes
+
+    def batches(self) -> List[Batch]:
+        from .autocache import AutoCacheRule
+
+        return super().batches() + [
+            Batch(
+                "Auto Cache",
+                Strategy.ONCE,
+                [AutoCacheRule(self.strategy, self.mem_budget_bytes)],
+            )
+        ]

@@ -1,0 +1,428 @@
+"""The typed pipeline layer: Chainable / Pipeline / lazy results / FittedPipeline.
+
+Parity targets: ``workflow/Chainable.scala``, ``Pipeline.scala``,
+``PipelineDataset.scala``, ``PipelineDatum.scala``, ``PipelineResult.scala``,
+``FittedPipeline.scala``, ``TransformerGraph.scala``.
+
+The TPU-first twist: once a pipeline is ``fit()``, the transformer-only chain
+can be *compiled* — every node that exposes a pure-jax ``trace_batch`` is
+composed into a single function and jitted, so the whole ``andThen`` chain
+becomes one fused XLA computation instead of N kernel launches
+(see :meth:`FittedPipeline.compile`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from ..data.dataset import Dataset
+from .env import PipelineEnv
+from .executor import GraphExecutor
+from .expressions import DatasetExpression, DatumExpression, Expression
+from .graph import Graph, NodeId, NodeOrSourceId, SinkId, SourceId
+from .operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    ExpressionOperator,
+    GatherTransformerOperator,
+    Operator,
+    TransformerOperator,
+)
+from . import analysis
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Lazy results
+# ---------------------------------------------------------------------------
+
+
+class PipelineResult:
+    """A lazy handle on the output of a pipeline execution
+    (parity: ``PipelineResult.scala``)."""
+
+    def __init__(self, executor: GraphExecutor, sink: SinkId):
+        self._executor = executor
+        self._sink = sink
+
+    @property
+    def graph(self) -> Graph:
+        return self._executor.graph
+
+    @property
+    def sink(self) -> SinkId:
+        return self._sink
+
+    def expression(self) -> Expression:
+        return self._executor.execute(self._sink)
+
+    def get(self) -> Any:
+        return self.expression().get()
+
+
+class PipelineDataset(PipelineResult):
+    """Lazy dataset result; also usable as the data input of another
+    pipeline/estimator (its graph is spliced in, preserving laziness)."""
+
+    def get(self) -> Dataset:
+        return super().get()
+
+    def collect(self) -> List[Any]:
+        return self.get().collect()
+
+    def to_array(self):
+        return self.get().to_array()
+
+    def __iter__(self):
+        return iter(self.get())
+
+
+class PipelineDatum(PipelineResult):
+    """Lazy single-datum result."""
+
+
+# ---------------------------------------------------------------------------
+# Graph-building helpers
+# ---------------------------------------------------------------------------
+
+
+def attach_data(graph: Graph, data: Any) -> tuple:
+    """Add ``data`` to ``graph`` as a dependency-able id.
+
+    Raw datasets/arrays become :class:`DatasetOperator` leaves. Lazy
+    :class:`PipelineDataset` / :class:`PipelineDatum` results have their whole
+    graph spliced in (so shared prefixes merge + stay lazy).
+    Returns ``(graph, dep_id)``.
+    """
+    if isinstance(data, PipelineResult):
+        other = data.graph
+        merged, _, sink_map = graph.add_graph(other)
+        dep = merged.get_sink_dependency(sink_map[data.sink])
+        # drop the imported sinks; keep everything else
+        for old_sink, new_sink in sink_map.items():
+            merged = merged.remove_sink(new_sink)
+        return merged, dep
+    if isinstance(data, Dataset):
+        op: Operator = DatasetOperator(data)
+    else:
+        op = DatasetOperator(Dataset.of(data))
+    graph, node = graph.add_node(op, [])
+    return graph, node
+
+
+def attach_datum(graph: Graph, datum: Any) -> tuple:
+    if isinstance(datum, PipelineResult):
+        return attach_data(graph, datum)
+    graph, node = graph.add_node(DatumOperator(datum), [])
+    return graph, node
+
+
+# ---------------------------------------------------------------------------
+# Chainable
+# ---------------------------------------------------------------------------
+
+
+class Chainable:
+    """Anything composable with ``and_then`` into a :class:`Pipeline`
+    (parity: ``Chainable.scala``). Subclasses: :class:`Pipeline` and
+    :class:`~keystone_tpu.workflow.transformer.Transformer`."""
+
+    def to_pipeline(self) -> "Pipeline":
+        raise NotImplementedError
+
+    def and_then(self, nxt: Any, *fit_data: Any) -> "Pipeline":
+        """``self`` then ``nxt``.
+
+        * ``and_then(transformer_or_pipeline)`` — plain composition.
+        * ``and_then(estimator, data)`` — fit ``estimator`` on ``self(data)``
+          and append the fitted model.
+        * ``and_then(label_estimator, data, labels)`` — ditto with labels.
+        """
+        if isinstance(nxt, EstimatorOperator):
+            if not hasattr(nxt, "with_data"):
+                raise TypeError(
+                    f"{type(nxt).__name__} is a bare EstimatorOperator; chainable "
+                    "estimators must subclass the typed Estimator/LabelEstimator "
+                    "(which provide with_data)"
+                )
+            if not fit_data:
+                raise ValueError(
+                    "and_then(estimator) needs training data: and_then(est, data[, labels])"
+                )
+            trained_input = self(fit_data[0])
+            fitted = nxt.with_data(trained_input, *fit_data[1:])
+            return self.to_pipeline()._compose(fitted)
+        if isinstance(nxt, Chainable):
+            if fit_data:
+                raise ValueError("fit data only applies when chaining an estimator")
+            return self.to_pipeline()._compose(nxt.to_pipeline())
+        raise TypeError(f"cannot chain {type(nxt).__name__}")
+
+    # ``a >> b`` sugar for and_then
+    def __rshift__(self, nxt: Any) -> "Pipeline":
+        return self.and_then(nxt)
+
+    def __call__(self, data: Any) -> PipelineResult:
+        return self.to_pipeline().apply(data)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline
+# ---------------------------------------------------------------------------
+
+
+class Pipeline(Chainable):
+    """A graph with exactly one unbound source and one sink
+    (parity: ``Pipeline.scala``)."""
+
+    def __init__(self, graph: Graph, source: SourceId, sink: SinkId):
+        self._graph = graph
+        self._source = source
+        self._sink = sink
+
+    # -- structure ------------------------------------------------------
+
+    @property
+    def graph(self) -> Graph:
+        return self._graph
+
+    @property
+    def source(self) -> SourceId:
+        return self._source
+
+    @property
+    def sink(self) -> SinkId:
+        return self._sink
+
+    def to_pipeline(self) -> "Pipeline":
+        return self
+
+    def to_dot(self, name: str = "pipeline") -> str:
+        return self._graph.to_dot(name)
+
+    def _compose(self, nxt: "Pipeline") -> "Pipeline":
+        """Splice self's sink into nxt's source (the ``andThen`` core)."""
+        merged, source_map, sink_map = self._graph.connect_graph(
+            nxt._graph, {self._sink: nxt._source}
+        )
+        return Pipeline(merged, self._source, sink_map[nxt._sink])
+
+    # -- application ----------------------------------------------------
+
+    def apply(self, data: Any) -> PipelineDataset:
+        """Lazily apply to a dataset; nothing executes until ``.get()``."""
+        graph, data_id = attach_data(self._graph, data)
+        graph = graph.replace_dependency(self._source, data_id)
+        graph = graph.remove_source(self._source)
+        executor = GraphExecutor(graph)
+        return PipelineDataset(executor, self._sink)
+
+    def apply_datum(self, datum: Any) -> PipelineDatum:
+        """Lazily apply to a single datum."""
+        graph, datum_id = attach_datum(self._graph, datum)
+        graph = graph.replace_dependency(self._source, datum_id)
+        graph = graph.remove_source(self._source)
+        executor = GraphExecutor(graph)
+        return PipelineDatum(executor, self._sink)
+
+    def __call__(self, data: Any) -> PipelineResult:
+        return self.apply(data)
+
+    # -- fitting --------------------------------------------------------
+
+    def fit(self) -> "FittedPipeline":
+        """Fit every estimator NOW and return a serializable transformer-only
+        pipeline (parity: ``Pipeline.scala:38-65``). This is the jit boundary:
+        the returned :class:`FittedPipeline` contains no estimators and can be
+        compiled to a single XLA computation."""
+        optimizer = PipelineEnv.get_or_create().optimizer
+        graph, annotations = optimizer.execute(self._graph)
+        executor = GraphExecutor(graph, optimize=False)
+        executor._annotations = annotations
+
+        for node in list(analysis.linearize(graph)):
+            if not isinstance(node, NodeId) or node not in graph.operators:
+                continue
+            op = graph.get_operator(node)
+            if isinstance(op, DelegatingOperator):
+                deps = graph.get_dependencies(node)
+                est_dep, data_deps = deps[0], deps[1:]
+                fitted = executor.execute(est_dep).get()
+                if not isinstance(fitted, TransformerOperator):
+                    raise TypeError(
+                        f"estimator at {est_dep} produced {type(fitted).__name__}, "
+                        "expected a TransformerOperator"
+                    )
+                graph = graph.set_operator(node, fitted)
+                graph = graph.set_dependencies(node, list(data_deps))
+                # Re-point the executor at the edited graph but keep memoized
+                # upstream results — only the edited node and its descendants
+                # are stale. Without this, fitting K chained estimators
+                # re-executes shared featurization K times.
+                stale = {node} | analysis.get_descendants(graph, node)
+                fresh = GraphExecutor(graph, optimize=False)
+                fresh._annotations = annotations
+                fresh._state = {
+                    gid: expr
+                    for gid, expr in executor._state.items()
+                    if gid not in stale
+                }
+                executor = fresh
+
+        from .rules import UnusedBranchRemovalRule
+
+        graph, _ = UnusedBranchRemovalRule().apply(graph, {})
+        for node in graph.nodes:
+            op = graph.get_operator(node)
+            if not isinstance(op, (TransformerOperator, ExpressionOperator, DatasetOperator, DatumOperator)):
+                raise TypeError(f"fit() left a non-transformer operator in the graph: {op.label}")
+        return FittedPipeline(graph, self._source, self._sink)
+
+    # -- combinators ----------------------------------------------------
+
+    @staticmethod
+    def gather(branches: Sequence[Chainable]) -> "Pipeline":
+        """Fan one input through every branch and zip the outputs into a
+        per-item sequence (parity: ``Pipeline.scala:119-154``)."""
+        if not branches:
+            raise ValueError("gather of zero branches")
+        graph = Graph()
+        graph, source = graph.add_source()
+        branch_outs: List[NodeOrSourceId] = []
+        for branch in branches:
+            bp = branch.to_pipeline()
+            merged, source_map, sink_map = graph.add_graph(bp.graph)
+            merged = merged.replace_dependency(source_map[bp.source], source)
+            merged = merged.remove_source(source_map[bp.source])
+            out = merged.get_sink_dependency(sink_map[bp.sink])
+            merged = merged.remove_sink(sink_map[bp.sink])
+            graph = merged
+            branch_outs.append(out)
+        graph, gather_node = graph.add_node(GatherTransformerOperator(), branch_outs)
+        graph, sink = graph.add_sink(gather_node)
+        return Pipeline(graph, source, sink)
+
+    @staticmethod
+    def identity() -> "Pipeline":
+        graph = Graph()
+        graph, source = graph.add_source()
+        graph, sink = graph.add_sink(source)
+        return Pipeline(graph, source, sink)
+
+
+# ---------------------------------------------------------------------------
+# FittedPipeline
+# ---------------------------------------------------------------------------
+
+
+class FittedPipeline(Chainable):
+    """An estimator-free pipeline: pure transformer application, serializable,
+    and compilable to a single jitted function
+    (parity: ``FittedPipeline.scala`` + the XLA-fusion north star)."""
+
+    def __init__(self, graph: Graph, source: SourceId, sink: SinkId):
+        self._graph = graph
+        self._source = source
+        self._sink = sink
+        self._compiled: Optional[Callable] = None
+
+    @property
+    def graph(self) -> Graph:
+        return self._graph
+
+    def to_pipeline(self) -> Pipeline:
+        return Pipeline(self._graph, self._source, self._sink)
+
+    # -- application (no optimizer pass: parity with reference, which applies
+    #    FittedPipelines without re-optimizing) --------------------------
+
+    def apply(self, data: Any) -> Dataset:
+        graph, data_id = attach_data(self._graph, data)
+        graph = graph.replace_dependency(self._source, data_id)
+        graph = graph.remove_source(self._source)
+        executor = GraphExecutor(graph, optimize=False)
+        return executor.execute(self._sink).get()
+
+    def apply_datum(self, datum: Any) -> Any:
+        graph, datum_id = attach_datum(self._graph, datum)
+        graph = graph.replace_dependency(self._source, datum_id)
+        graph = graph.remove_source(self._source)
+        executor = GraphExecutor(graph, optimize=False)
+        return executor.execute(self._sink).get()
+
+    def __call__(self, data: Any) -> Any:
+        return self.apply(data)
+
+    # -- compilation ----------------------------------------------------
+
+    def trace_fn(self) -> Optional[Callable]:
+        """Build one pure function (stacked-array in → stacked-array out) from
+        the transformer DAG, if every node exposes ``trace_batch``.
+
+        Returns None when any node is untraceable (host-side, ragged, ...).
+        """
+        graph, source, sink = self._graph, self._source, self._sink
+
+        for node in graph.nodes:
+            op = graph.get_operator(node)
+            if isinstance(op, GatherTransformerOperator):
+                continue
+            if getattr(op, "trace_batch", None) is None:
+                logger.info("pipeline not traceable: %s has no trace_batch", op.label)
+                return None
+
+        order = [n for n in analysis.linearize(graph) if isinstance(n, NodeId)]
+
+        def fn(x):
+            values: Dict[Any, Any] = {source: x}
+            for node in order:
+                args = [values[d] for d in graph.get_dependencies(node)]
+                op = graph.get_operator(node)
+                if isinstance(op, GatherTransformerOperator):
+                    values[node] = tuple(args)
+                else:
+                    values[node] = op.trace_batch(*args)
+            return values[graph.get_sink_dependency(sink)]
+
+        return fn
+
+    def compile(self) -> Callable:
+        """Jit the composed transformer chain into one XLA computation."""
+        import jax
+
+        fn = self.trace_fn()
+        if fn is None:
+            raise ValueError("pipeline contains untraceable nodes; cannot compile")
+        self._compiled = jax.jit(fn)
+        return self._compiled
+
+    def apply_compiled(self, data: Any) -> Any:
+        if self._compiled is None:
+            self.compile()
+        arr = Dataset.of(data).to_array() if not hasattr(data, "shape") else data
+        return self._compiled(arr)
+
+    # -- persistence ----------------------------------------------------
+
+    def save(self, path: str) -> None:
+        from ..utils.serialization import save_pickle
+
+        save_pickle(self, path)
+
+    @staticmethod
+    def load(path: str) -> "FittedPipeline":
+        from ..utils.serialization import load_pickle
+
+        obj = load_pickle(path)
+        if not isinstance(obj, FittedPipeline):
+            raise TypeError(f"{path} does not contain a FittedPipeline")
+        return obj
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_compiled"] = None  # jitted callables don't pickle
+        return state

@@ -1,0 +1,27 @@
+"""Test harness: an 8-device CPU jax backend stands in for the cluster, the
+same way Spark local[n] does in the reference's PipelineContext
+(src/test/scala/keystoneml/workflow/PipelineContext.scala:9-25)."""
+
+import os
+
+# Must happen before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def pipeline_env():
+    """Reset global pipeline state around every test (parity:
+    PipelineContext.afterEach resetting PipelineEnv)."""
+    from keystone_tpu.workflow.env import PipelineEnv
+
+    env = PipelineEnv.get_or_create()
+    env.reset()
+    yield env
+    env.reset()

@@ -1,0 +1,124 @@
+"""Operator semantics (parity: workflow/OperatorSuite.scala)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.workflow.expressions import (
+    DatasetExpression,
+    DatumExpression,
+    TransformerExpression,
+)
+from keystone_tpu.workflow.operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    ExpressionOperator,
+    GatherTransformerOperator,
+)
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def test_dataset_operator():
+    ds = Dataset.from_array(jnp.arange(6).reshape(3, 2))
+    op = DatasetOperator(ds)
+    out = op.execute([])
+    assert isinstance(out, DatasetExpression)
+    assert len(out.get()) == 3
+    with pytest.raises(ValueError):
+        op.execute([DatumExpression.now(1)])
+
+
+def test_datum_operator():
+    op = DatumOperator(42)
+    assert op.execute([]).get() == 42
+
+
+def test_function_transformer_batch_and_single():
+    t = FunctionNode(batch_fn=lambda X: X * 2)
+    ds_expr = DatasetExpression.now(Dataset.from_array(jnp.ones((4, 3))))
+    out = t.execute([ds_expr])
+    assert isinstance(out, DatasetExpression)
+    np.testing.assert_allclose(np.asarray(out.get().to_array()), 2.0)
+
+    datum_expr = DatumExpression.now(jnp.ones(3))
+    single = t.execute([datum_expr])
+    assert isinstance(single, DatumExpression)
+    np.testing.assert_allclose(np.asarray(single.get()), 2.0)
+
+
+def test_transformer_laziness():
+    calls = []
+
+    def f(X):
+        calls.append(1)
+        return X
+
+    t = FunctionNode(batch_fn=f)
+    expr = t.execute([DatasetExpression.now(Dataset.from_array(jnp.ones((2, 2))))])
+    assert calls == []  # nothing ran yet
+    expr.get()
+    expr.get()
+    assert calls == [1]  # memoized
+
+
+def test_estimator_operator_laziness_and_memoization():
+    fits = []
+
+    class MeanShift(EstimatorOperator):
+        def fit(self, data):
+            fits.append(1)
+            mu = jnp.mean(data.to_array(), axis=0)
+            return FunctionNode(batch_fn=lambda X: X - mu)
+
+    est = MeanShift()
+    data = DatasetExpression.now(Dataset.from_array(jnp.asarray([[1.0, 3.0], [3.0, 5.0]])))
+    texpr = est.execute([data])
+    assert isinstance(texpr, TransformerExpression)
+    assert fits == []
+    fitted = texpr.get()
+    assert fits == [1]
+    texpr.get()
+    assert fits == [1]
+    out = fitted.execute([data]).get().to_array()
+    np.testing.assert_allclose(np.asarray(out), [[-1.0, -1.0], [1.0, 1.0]])
+
+
+def test_delegating_operator():
+    t = FunctionNode(batch_fn=lambda X: X + 1)
+    texpr = TransformerExpression.now(t)
+    data = DatasetExpression.now(Dataset.from_array(jnp.zeros((2, 2))))
+    out = DelegatingOperator().execute([texpr, data])
+    np.testing.assert_allclose(np.asarray(out.get().to_array()), 1.0)
+
+    datum = DatumExpression.now(jnp.zeros(2))
+    out_single = DelegatingOperator().execute([texpr, datum])
+    np.testing.assert_allclose(np.asarray(out_single.get()), 1.0)
+
+    with pytest.raises(ValueError):
+        DelegatingOperator().execute([data])
+    with pytest.raises(ValueError):
+        DelegatingOperator().execute([data, data])
+
+
+def test_expression_operator_passthrough():
+    e = DatumExpression.now(7)
+    assert ExpressionOperator(e).execute([]) is e
+
+
+def test_gather_zip_batched():
+    a = DatasetExpression.now(Dataset.from_array(jnp.ones((3, 2))))
+    b = DatasetExpression.now(Dataset.from_array(jnp.zeros((3, 4))))
+    out = GatherTransformerOperator().execute([a, b]).get()
+    assert out.is_batched
+    pa, pb = out.payload
+    assert pa.shape == (3, 2) and pb.shape == (3, 4)
+
+
+def test_gather_single():
+    a = DatumExpression.now(1)
+    b = DatumExpression.now(2)
+    out = GatherTransformerOperator().execute([a, b]).get()
+    assert out == [1, 2]

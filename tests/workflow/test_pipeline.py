@@ -1,0 +1,198 @@
+"""Pipeline semantics (parity: workflow/PipelineSuite.scala — laziness,
+fit-once state reuse, chaining, gather, FittedPipeline) plus the TPU-specific
+whole-chain compilation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu import (
+    Dataset,
+    Estimator,
+    FunctionNode,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+)
+
+
+class Doubler(Transformer):
+    def trace_batch(self, X):
+        return X * 2
+
+
+class AddOne(Transformer):
+    def trace_batch(self, X):
+        return X + 1
+
+
+class Shift(Transformer):
+    def __init__(self, mu):
+        self.mu = mu
+
+    def trace_batch(self, X):
+        return X - self.mu
+
+
+class CountingMeanCenter(Estimator):
+    """Estimator counting fits, for fit-once semantics."""
+
+    def __init__(self):
+        self.num_fits = 0
+
+    def fit(self, data):
+        self.num_fits += 1
+        return Shift(jnp.mean(data.to_array(), axis=0))
+
+
+class CountingLinear(LabelEstimator):
+    def __init__(self):
+        self.num_fits = 0
+
+    def fit(self, data, labels):
+        self.num_fits += 1
+        X = data.to_array()
+        y = labels.to_array()
+        w, *_ = jnp.linalg.lstsq(X, y, rcond=None)
+        return FunctionNode(batch_fn=lambda A: A @ w, label="linmap")
+
+
+def test_transformer_chain_lazy_and_correct():
+    pipe = Doubler().and_then(AddOne())
+    data = jnp.ones((4, 3))
+    result = pipe(data)  # lazy
+    out = result.get().to_array()
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+def test_rshift_sugar():
+    pipe = Doubler() >> AddOne() >> Doubler()
+    out = pipe(jnp.ones((2, 2))).get().to_array()
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+
+
+def test_apply_datum():
+    pipe = Doubler().to_pipeline()
+    out = pipe.apply_datum(jnp.asarray([1.0, 2.0])).get()
+    np.testing.assert_allclose(np.asarray(out), [2.0, 4.0])
+
+
+def test_estimator_fit_once_across_applications():
+    """Parity: PipelineSuite 'only fit once' (numFits === 1)."""
+    est = CountingMeanCenter()
+    data = Dataset.from_array(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]))
+    pipe = est.with_data(data)
+    assert est.num_fits == 0  # lazy
+    out1 = pipe(data).get().to_array()
+    assert est.num_fits == 1
+    out2 = pipe(data).get().to_array()
+    assert est.num_fits == 1  # saved state reused, not refit
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+
+def test_estimator_fit_once_shared_between_pipelines():
+    est = CountingMeanCenter()
+    data = Dataset.from_array(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]))
+    pipe_a = est.with_data(data)
+    pipe_b = est.with_data(data)
+    pipe_a(data).get()
+    pipe_b(data).get()
+    assert est.num_fits == 1  # same estimator instance + same data => one fit
+
+
+def test_chain_with_estimator_trains_on_chained_data():
+    """and_then(est, raw_data): estimator must see raw data pushed through the
+    upstream chain (parity: Chainable.scala estimator overloads)."""
+
+    seen = {}
+
+    class Probe(Estimator):
+        def fit(self, data):
+            seen["data"] = np.asarray(data.to_array())
+            return Identity()
+
+    raw = jnp.ones((2, 2))
+    pipe = Doubler().and_then(Probe(), raw)
+    pipe(raw).get()
+    np.testing.assert_allclose(seen["data"], 2.0)
+
+
+def test_label_estimator_pipeline():
+    X = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0], [2.0, 1.0]])
+    w_true = jnp.asarray([[1.0], [2.0]])
+    y = X @ w_true
+    est = CountingLinear()
+    pipe = est.with_data(X, y)
+    pred = pipe(X).get().to_array()
+    np.testing.assert_allclose(np.asarray(pred), np.asarray(y), atol=1e-4)
+    assert est.num_fits == 1
+
+
+def test_gather():
+    pipe = Pipeline.gather([Doubler(), AddOne()])
+    out = pipe(jnp.ones((3, 2))).get()
+    assert out.is_batched
+    doubled, plus1 = out.payload
+    np.testing.assert_allclose(np.asarray(doubled), 2.0)
+    np.testing.assert_allclose(np.asarray(plus1), 2.0)
+
+
+def test_fit_produces_estimator_free_pipeline():
+    est = CountingMeanCenter()
+    data = Dataset.from_array(jnp.asarray([[0.0, 0.0], [2.0, 2.0]]))
+    pipe = Doubler().and_then(est, data)
+    fitted = pipe.fit()
+    assert est.num_fits == 1
+    # applying the fitted pipeline does not refit
+    out = fitted.apply(jnp.asarray([[1.0, 1.0]])).to_array()
+    assert est.num_fits == 1
+    # doubled to 2, mean of doubled train data is 2 => 0
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+    single = fitted.apply_datum(jnp.asarray([1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(single), 0.0)
+
+
+def test_fitted_pipeline_save_load(tmp_path):
+    est = CountingMeanCenter()
+    data = Dataset.from_array(jnp.asarray([[0.0, 0.0], [2.0, 2.0]]))
+    fitted = Doubler().and_then(est, data).fit()
+    path = str(tmp_path / "pipe.pkl")
+    fitted.save(path)
+    from keystone_tpu.workflow.pipeline import FittedPipeline
+
+    loaded = FittedPipeline.load(path)
+    out = loaded.apply(jnp.asarray([[1.0, 1.0]])).to_array()
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+def test_fitted_pipeline_compiles_to_one_jaxpr():
+    """The flagship TPU behavior: the whole andThen chain jits into a single
+    XLA computation."""
+    est = CountingMeanCenter()
+    data = Dataset.from_array(jnp.asarray([[0.0, 0.0], [2.0, 2.0]]))
+    fitted = (Doubler() >> AddOne()).and_then(est, data).fit()
+    fn = fitted.trace_fn()
+    assert fn is not None
+    jitted = jax.jit(fn)
+    out = jitted(jnp.asarray([[1.0, 1.0]]))
+    expected = fitted.apply(jnp.asarray([[1.0, 1.0]])).to_array()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected))
+    # and it really is one traced computation
+    jaxpr = jax.make_jaxpr(fn)(jnp.ones((1, 2)))
+    assert jaxpr is not None
+
+
+def test_common_subexpression_merged():
+    """Two branches sharing the same upstream transformer instance execute it
+    once (parity: EquivalentNodeMergeRule)."""
+    calls = []
+
+    def counting(X):
+        calls.append(1)
+        return X * 2
+
+    shared = FunctionNode(batch_fn=counting, label="shared")
+    pipe = Pipeline.gather([shared.to_pipeline(), shared.to_pipeline()])
+    pipe(jnp.ones((2, 2))).get()
+    assert len(calls) == 1
