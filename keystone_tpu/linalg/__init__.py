@@ -1,0 +1,23 @@
+"""Mesh-native distributed linear algebra (replaces the external mlmatrix
+package: RowPartitionedMatrix, NormalEquations, BlockCoordinateDescent, TSQR —
+build.sbt:45)."""
+
+from .row_matrix import RowShardedMatrix, cross, gram, solve_spd
+from .normal_equations import (
+    solve_least_squares,
+    solve_least_squares_with_intercept,
+)
+from .bcd import solve_blockwise_l2, solve_blockwise_l2_scan
+from .tsqr import tsqr_r
+
+__all__ = [
+    "RowShardedMatrix",
+    "gram",
+    "cross",
+    "solve_spd",
+    "solve_least_squares",
+    "solve_least_squares_with_intercept",
+    "solve_blockwise_l2",
+    "solve_blockwise_l2_scan",
+    "tsqr_r",
+]

@@ -1,0 +1,128 @@
+"""Block-coordinate-descent least squares — the workhorse solver substrate.
+
+Parity: mlmatrix ``BlockCoordinateDescent.solveLeastSquaresWithL2`` /
+``solveOnePassL2`` as driven by ``BlockLeastSquaresEstimator``
+(nodes/learning/BlockLinearMapper.scala:212-243). The reference's shape: a
+driver loop over feature blocks; per block a cluster-wide Gram + cross-product
+(map + treeReduce over the network) and a driver-local ``(G+λI) \\ rhs`` solve,
+then a broadcast + residual update.
+
+Mesh-native shape: the same host loop over blocks (keeps HBM bounded and
+shapes static), but each block step is ONE jit-compiled program — per-shard
+GEMMs with XLA-inserted psum over ICI for the Gram/cross terms, Cholesky solve
+on-device, and a donated, row-sharded prediction buffer updated in place. No
+broadcast step exists: the block model comes out replicated.
+
+Objective: min_W  Σ‖Σ_j A_j W_j − y‖² + λ Σ_j ‖W_j‖²  (one W_j per block).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .row_matrix import solve_spd
+
+
+@partial(jax.jit, donate_argnums=(2,))
+def _block_update(
+    Aj: jax.Array,
+    Wj_old: jax.Array,
+    pred: jax.Array,
+    y: jax.Array,
+    reg: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """One BCD block step. Returns (Wj_new, new_pred).
+
+    residual for block j:  r_j = y − pred + A_j W_j_old
+    W_j ← (A_jᵀA_j + λI)⁻¹ A_jᵀ r_j ; pred ← pred + A_j (W_j − W_j_old)
+    """
+    r = y - pred + Aj @ Wj_old
+    G = Aj.T @ Aj          # psum over data axis
+    c = Aj.T @ r           # psum over data axis
+    Wj = solve_spd(G, c, reg)
+    pred = pred + Aj @ (Wj - Wj_old)
+    return Wj, pred
+
+
+def solve_blockwise_l2(
+    blocks: Sequence[jax.Array],
+    y: jax.Array,
+    reg: float,
+    num_iter: int = 1,
+    dtype=jnp.float32,
+) -> List[jax.Array]:
+    """L2-regularised least squares over feature blocks by BCD.
+
+    blocks: list of (n, b_j) row-sharded arrays (the VectorSplitter output);
+    y: (n, k) row-sharded. ``num_iter=1`` is the reference's one-pass variant
+    (``solveOnePassL2``), used by MNIST/CIFAR/VOC. Returns per-block (b_j, k)
+    weights.
+    """
+    y = jnp.asarray(y, dtype=dtype)
+    n, k = y.shape
+    blocks = [jnp.asarray(b, dtype=dtype) for b in blocks]
+    Ws = [jnp.zeros((b.shape[1], k), dtype=dtype) for b in blocks]
+    pred = jnp.zeros_like(y)
+    for _ in range(num_iter):
+        for j, Aj in enumerate(blocks):
+            Ws[j], pred = _block_update(Aj, Ws[j], pred, y, reg)
+    return Ws
+
+
+def solve_blockwise_l2_scan(
+    A: jax.Array,
+    y: jax.Array,
+    reg: float,
+    block_size: int,
+    num_iter: int = 1,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Fully-compiled BCD when the whole design matrix fits in HBM.
+
+    A: (n, d) with d divisible into uniform ``block_size`` column blocks. The
+    block loop becomes a ``lax.scan`` inside one jit program — zero host round
+    trips per block, the compiled analogue of the reference's driver loop.
+    Returns the full (d, k) weight matrix.
+    """
+    A = jnp.asarray(A, dtype=dtype)
+    y = jnp.asarray(y, dtype=dtype)
+    d = A.shape[1]
+    if d % block_size != 0:
+        raise ValueError(f"d={d} not divisible by block_size={block_size}")
+    return _bcd_scan(A, y, jnp.asarray(reg, dtype), block_size, num_iter)
+
+
+@partial(jax.jit, static_argnames=("block_size", "num_iter"))
+def _bcd_scan(A, y, reg, block_size, num_iter):
+    n, d = A.shape
+    nblocks = d // block_size
+    k = y.shape[1]
+    # (nblocks, n, bs) stacking keeps shapes static for scan.
+    A_blocks = jnp.transpose(A.reshape(n, nblocks, block_size), (1, 0, 2))
+    W0 = jnp.zeros((nblocks, block_size, k), dtype=A.dtype)
+    pred0 = jnp.zeros_like(y)
+
+    def epoch(carry, _):
+        W, pred = carry
+
+        def block_step(carry, j):
+            W, pred = carry
+            Aj = A_blocks[j]
+            Wj = W[j]
+            r = y - pred + Aj @ Wj
+            G = Aj.T @ Aj
+            c = Aj.T @ r
+            Wj_new = solve_spd(G, c, reg)
+            pred = pred + Aj @ (Wj_new - Wj)
+            W = W.at[j].set(Wj_new)
+            return (W, pred), None
+
+        (W, pred), _ = jax.lax.scan(block_step, (W, pred), jnp.arange(nblocks))
+        return (W, pred), None
+
+    (W, pred), _ = jax.lax.scan(epoch, (W0, pred0), None, length=num_iter)
+    return W.reshape(d, k)

@@ -1,0 +1,58 @@
+"""Least squares by normal equations, mesh-native.
+
+Parity: mlmatrix ``NormalEquations.solveLeastSquares(WithL2)`` as consumed by
+``LinearMapEstimator`` (nodes/learning/LinearMapper.scala:121-139). The
+reference maps per-partition (AᵀA, Aᵀb) and treeReduces to the driver which
+solves locally; here one jit program computes the Gram and cross terms (psum
+over ICI) and solves on-device via Cholesky.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .row_matrix import solve_spd
+
+
+@jax.jit
+def _ne_solve(A, b, reg):
+    G = A.T @ A
+    c = A.T @ b
+    return solve_spd(G, c, reg)
+
+
+@jax.jit
+def _ne_solve_intercept(A, b, reg):
+    a_mean = jnp.mean(A, axis=0)
+    b_mean = jnp.mean(b, axis=0)
+    Ac = A - a_mean
+    bc = b - b_mean
+    G = Ac.T @ Ac
+    c = Ac.T @ bc
+    W = solve_spd(G, c, reg)
+    intercept = b_mean - a_mean @ W
+    return W, intercept
+
+
+def solve_least_squares(
+    A: jax.Array,
+    b: jax.Array,
+    reg: float = 0.0,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """argmin_X ‖AX − b‖² + reg·‖X‖² via (AᵀA + reg·I) X = Aᵀb.
+
+    A: (n, d) row-sharded; b: (n, k) row-sharded. Returns (d, k) replicated.
+    """
+    return _ne_solve(A.astype(dtype), b.astype(dtype), jnp.asarray(reg, dtype))
+
+
+def solve_least_squares_with_intercept(
+    A: jax.Array, b: jax.Array, reg: float = 0.0, dtype=jnp.float32
+):
+    """Mean-centered least squares returning (weights, intercept) — the
+    pattern LinearMapEstimator uses with StandardScaler-centered data."""
+    return _ne_solve_intercept(
+        A.astype(dtype), b.astype(dtype), jnp.asarray(reg, dtype)
+    )

@@ -1,0 +1,75 @@
+"""Row-sharded tall-skinny matrices — the mesh-native ``RowPartitionedMatrix``.
+
+The reference's distributed linear algebra lives in the external mlmatrix
+package (build.sbt:45): ``RowPartitionedMatrix`` (an RDD of row blocks),
+``NormalEquations``, ``TSQR``. Here a "distributed matrix" is simply a
+``jax.Array`` whose leading dim is sharded over the mesh's data axis; all the
+block-wise map + treeReduce choreography collapses into jit-compiled programs
+where XLA inserts the ICI collectives.
+
+Everything takes/returns plain arrays — there is deliberately no wrapper class
+to thread through jit. ``RowShardedMatrix`` below is a thin convenience holder
+for host-side code that wants the reference's vocabulary.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import default_mesh, shard_batch
+
+
+@partial(jax.jit, static_argnames=("dtype",))
+def gram(A: jax.Array, dtype=None) -> jax.Array:
+    """AᵀA. With A row-sharded, XLA lowers this to per-shard GEMM + psum over
+    ICI — the reference's map+treeReduce Gram pattern
+    (BlockWeightedLeastSquares.scala:212-225) with the tree left to XLA."""
+    if dtype is not None:
+        A = A.astype(dtype)
+    return A.T @ A
+
+
+@jax.jit
+def cross(A: jax.Array, B: jax.Array) -> jax.Array:
+    """AᵀB with both row-sharded: per-shard GEMM + psum."""
+    return A.T @ B
+
+
+def solve_spd(G: jax.Array, rhs: jax.Array, reg: float = 0.0) -> jax.Array:
+    """Solve (G + reg·I) X = rhs for symmetric positive-definite G via
+    Cholesky (the reference's driver-side ``(G+λI) \\ rhs``)."""
+    G = G + reg * jnp.eye(G.shape[0], dtype=G.dtype)
+    cho = jax.scipy.linalg.cho_factor(G, lower=True)
+    return jax.scipy.linalg.cho_solve(cho, rhs)
+
+
+class RowShardedMatrix:
+    """Host-side convenience wrapper: a tall-skinny matrix sharded by rows.
+
+    Parity: mlmatrix ``RowPartitionedMatrix.fromArray`` (used at
+    LinearMapper.scala:121). ``data`` is an (n, d) jax.Array living sharded
+    in HBM.
+    """
+
+    def __init__(self, data, mesh=None):
+        self.mesh = mesh or default_mesh()
+        self.data = shard_batch(jnp.asarray(data), self.mesh)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    def gram(self, dtype=None) -> jax.Array:
+        return gram(self.data, dtype=dtype)
+
+    def t_times(self, other: "RowShardedMatrix | jax.Array") -> jax.Array:
+        o = other.data if isinstance(other, RowShardedMatrix) else other
+        return cross(self.data, o)
+
+    def qr_r(self) -> jax.Array:
+        from .tsqr import tsqr_r
+
+        return tsqr_r(self.data, mesh=self.mesh)

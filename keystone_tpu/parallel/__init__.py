@@ -1,0 +1,35 @@
+"""Device-mesh substrate: mesh construction, sharding helpers, resharding.
+Replaces Spark's executor/partition/broadcast/treeReduce machinery (SURVEY
+SS2.7) with jax.sharding over ICI/DCN."""
+
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    column_sharding,
+    default_mesh,
+    make_mesh,
+    mesh_n_data,
+    pad_to_multiple,
+    replicate,
+    replicated_sharding,
+    set_default_mesh,
+    shard_batch,
+    use_mesh,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharding",
+    "column_sharding",
+    "default_mesh",
+    "make_mesh",
+    "mesh_n_data",
+    "pad_to_multiple",
+    "replicate",
+    "replicated_sharding",
+    "set_default_mesh",
+    "shard_batch",
+    "use_mesh",
+]

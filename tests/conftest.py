@@ -4,13 +4,21 @@ same way Spark local[n] does in the reference's PipelineContext
 
 import os
 
-# Must happen before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax is imported anywhere. Force CPU even when the outer
+# environment points at a real accelerator (JAX_PLATFORMS=axon): tests need
+# the 8-device virtual mesh, and the single real chip can't provide it.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# sitecustomize pre-imports jax before this conftest runs, so the env var
+# alone is too late — update the live config as well.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
