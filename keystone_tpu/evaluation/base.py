@@ -1,0 +1,25 @@
+"""Evaluator base (parity: evaluation/Evaluator.scala:19 — accepts any mix of
+raw collections, Datasets and lazy PipelineDatasets for both arguments)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def resolve(x: Any) -> np.ndarray:
+    """Materialize predictions/labels: PipelineDataset → Dataset → array."""
+    from ..data.dataset import Dataset
+    from ..workflow.pipeline import PipelineResult
+
+    if isinstance(x, PipelineResult):
+        x = x.get()
+    if isinstance(x, Dataset):
+        x = x.to_array()
+    return np.asarray(x)
+
+
+class Evaluator:
+    def evaluate(self, predictions: Any, labels: Any):
+        raise NotImplementedError

@@ -19,7 +19,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from ..parallel.mesh import DATA_AXIS, default_mesh, shard_batch
+from functools import lru_cache
+
+from ..parallel.mesh import DATA_AXIS, default_mesh, pad_to_multiple, shard_batch
 
 
 def _fix_sign(R: jax.Array) -> jax.Array:
@@ -30,11 +32,10 @@ def _fix_sign(R: jax.Array) -> jax.Array:
     return R * s[:, None]
 
 
-def tsqr_r(A, mesh: Optional[Mesh] = None) -> jax.Array:
-    """The R factor of A's QR decomposition; A (n, d) row-sharded, R (d, d)
-    replicated."""
-    mesh = mesh or default_mesh()
-    A = shard_batch(jnp.asarray(A), mesh)
+@lru_cache(maxsize=None)
+def _tsqr_fn(mesh: Mesh):
+    """Per-mesh compiled TSQR program (cached so repeated calls — e.g. a
+    DistributedPCA loop — hit the jit cache instead of re-compiling)."""
 
     @jax.jit
     @partial(
@@ -51,4 +52,14 @@ def tsqr_r(A, mesh: Optional[Mesh] = None) -> jax.Array:
         R = jnp.linalg.qr(R_stacked, mode="r")
         return _fix_sign(R)
 
-    return _tsqr(A)
+    return _tsqr
+
+
+def tsqr_r(A, mesh: Optional[Mesh] = None) -> jax.Array:
+    """The R factor of A's QR decomposition; A (n, d) row-sharded, R (d, d)
+    replicated. Row counts that don't divide the data-axis size are zero-row
+    padded first — [A; 0] has the same R factor."""
+    mesh = mesh or default_mesh()
+    A, _ = pad_to_multiple(jnp.asarray(A), mesh.shape[DATA_AXIS], axis=0)
+    A = shard_batch(A, mesh)
+    return _tsqr_fn(mesh)(A)

@@ -1,0 +1,3 @@
+from .csv_loader import LabeledData, load_csv, load_labeled_csv
+
+__all__ = ["LabeledData", "load_csv", "load_labeled_csv"]

@@ -1,0 +1,41 @@
+"""CSV loading (parity: loaders/CsvDataLoader.scala:10-34 — comma/space
+split rows → vectors) plus the (label, features) convention used by the MNIST
+pipeline (pipelines/images/mnist/MnistRandomFFT.scala:35-38: column 0 is a
+1-indexed class label)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.dataset import Dataset
+
+
+def load_csv(path: str, dtype=np.float32) -> Dataset:
+    """Load a numeric CSV (comma or whitespace separated) as a batched
+    Dataset of rows."""
+    try:
+        arr = np.loadtxt(path, delimiter=",", dtype=dtype, ndmin=2)
+    except ValueError:
+        arr = np.loadtxt(path, dtype=dtype, ndmin=2)
+    return Dataset.from_array(arr)
+
+
+def load_labeled_csv(
+    path: str, label_offset: int = 0, dtype=np.float32
+) -> "LabeledData":
+    """Column 0 = class label (minus ``label_offset``), rest = features."""
+    arr = np.asarray(load_csv(path, dtype=dtype).payload)
+    labels = arr[:, 0].astype(np.int32) - label_offset
+    return LabeledData(labels, arr[:, 1:])
+
+
+class LabeledData:
+    """A labeled dataset: ``.data`` and ``.labels`` (parity:
+    loaders/LabeledData.scala:12)."""
+
+    def __init__(self, labels, data):
+        self.labels = Dataset.of(labels)
+        self.data = Dataset.of(data)
+
+    def __len__(self) -> int:
+        return len(self.data)

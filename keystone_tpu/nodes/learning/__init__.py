@@ -1,0 +1,15 @@
+from .cost import CostModel
+from .linear import (
+    BlockLeastSquaresEstimator,
+    BlockLinearMapper,
+    LinearMapEstimator,
+    LinearMapper,
+)
+
+__all__ = [
+    "CostModel",
+    "BlockLeastSquaresEstimator",
+    "BlockLinearMapper",
+    "LinearMapEstimator",
+    "LinearMapper",
+]

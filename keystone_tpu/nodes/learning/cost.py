@@ -1,0 +1,36 @@
+"""Solver cost models (parity: nodes/learning/CostModel.scala:6 and the
+fitted cluster constants at LeastSquaresEstimator.scala:28-31).
+
+The functional form max(cpu·flops, mem·bytes) + net·network carries over
+unchanged; on TPU the three weights describe MXU throughput, HBM bandwidth
+and ICI bandwidth instead of EC2 cores/RAM/Ethernet. Constants are
+recalibrated by ``scripts/calibrate_cost_model.py`` output; defaults below
+are v5e-order-of-magnitude estimates (flops ≈ 1/394e12 s, HBM ≈ 1/819e9 s,
+ICI ≈ 1/4.5e10 s per element, relative units).
+"""
+
+from __future__ import annotations
+
+
+class CostModel:
+    """Estimated cost of fitting this solver on (n, d, k) data."""
+
+    def cost(
+        self,
+        n: int,
+        d: int,
+        k: int,
+        sparsity: float,
+        num_machines: int,
+        cpu_weight: float,
+        mem_weight: float,
+        network_weight: float,
+    ) -> float:
+        raise NotImplementedError
+
+
+# Default weights, recalibratable on real hardware. Ratios matter, absolute
+# scale does not (same as the reference's fitted constants).
+DEFAULT_CPU_WEIGHT = 2.5e-12
+DEFAULT_MEM_WEIGHT = 1.2e-9
+DEFAULT_NETWORK_WEIGHT = 2.2e-11

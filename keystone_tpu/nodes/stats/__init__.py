@@ -1,0 +1,25 @@
+from .core import (
+    ColumnSampler,
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    Sampler,
+    SignedHellingerMapper,
+    StandardScaler,
+    StandardScalerModel,
+)
+
+__all__ = [
+    "ColumnSampler",
+    "CosineRandomFeatures",
+    "LinearRectifier",
+    "NormalizeRows",
+    "PaddedFFT",
+    "RandomSignNode",
+    "Sampler",
+    "SignedHellingerMapper",
+    "StandardScaler",
+    "StandardScalerModel",
+]

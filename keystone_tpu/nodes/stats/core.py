@@ -1,0 +1,198 @@
+"""Statistics / random-feature nodes.
+
+Parity targets: ``nodes/stats/`` in the reference — PaddedFFT.scala:13,
+CosineRandomFeatures.scala:19,49, RandomSignNode.scala:11,
+StandardScaler.scala:16,38, LinearRectifier.scala:12, NormalizeRows.scala:10,
+SignedHellingerMapper.scala:12,18, Sampling.scala:12,28.
+
+Every numeric node here is a pure ``trace_batch`` over the stacked (n, d)
+array: elementwise ops fuse into neighbouring matmuls under jit, the
+random-feature GEMM rides the MXU, and the fit-side reductions (mean/var)
+lower to psum over the mesh when the input is sharded.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...workflow.transformer import Estimator, Transformer
+
+
+class PaddedFFT(Transformer):
+    """Zero-pad each vector to the next power of two and return the real part
+    of the first half of its FFT (parity: PaddedFFT.scala:13-21). d →
+    2^ceil(log2 d) / 2 output features; rfft keeps XLA from computing the
+    redundant conjugate half."""
+
+    def trace_batch(self, X):
+        d = X.shape[-1]
+        padded = 1 << max(0, (d - 1)).bit_length()
+        X = jnp.pad(X, [(0, 0)] * (X.ndim - 1) + [(0, padded - d)])
+        # rfft returns padded/2+1 bins; the reference keeps bins [0, padded/2).
+        return jnp.fft.rfft(X, axis=-1).real[..., : padded // 2]
+
+
+class RandomSignNode(Transformer):
+    """Elementwise multiply by a fixed random ±1 vector
+    (parity: RandomSignNode.scala:11,19-24)."""
+
+    def __init__(self, signs):
+        self.signs = jnp.asarray(signs)
+
+    @staticmethod
+    def create(size: int, seed: int = 0) -> "RandomSignNode":
+        signs = 2.0 * jax.random.bernoulli(
+            jax.random.PRNGKey(seed), 0.5, (size,)
+        ).astype(jnp.float32) - 1.0
+        return RandomSignNode(signs)
+
+    def trace_batch(self, X):
+        return X * self.signs
+
+
+class LinearRectifier(Transformer):
+    """max(maxVal, x − alpha) (parity: LinearRectifier.scala:12-17)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = max_val
+        self.alpha = alpha
+
+    def trace_batch(self, X):
+        return jnp.maximum(self.max_val, X - self.alpha)
+
+
+class NormalizeRows(Transformer):
+    """Scale each row to unit L2 norm (zero rows pass through unchanged)."""
+
+    def trace_batch(self, X):
+        norm = jnp.linalg.norm(X, axis=-1, keepdims=True)
+        return X / jnp.where(norm == 0, 1.0, norm)
+
+
+class SignedHellingerMapper(Transformer):
+    """x → sign(x)·√|x| (parity: SignedHellingerMapper.scala:12-16)."""
+
+    def trace_batch(self, X):
+        return jnp.sign(X) * jnp.sqrt(jnp.abs(X))
+
+
+class CosineRandomFeatures(Transformer):
+    """Random Fourier features cos(x Wᵀ + b)
+    (parity: CosineRandomFeatures.scala:19-44; batched GEMM is the reference's
+    mapPartitions + BLAS3 path, here one MXU matmul).
+
+    W: (num_output_features, num_input_features); b: (num_output_features,).
+    """
+
+    def __init__(self, W, b):
+        self.W = jnp.asarray(W)
+        self.b = jnp.asarray(b)
+        if self.b.shape[0] != self.W.shape[0]:
+            raise ValueError("rows of W and size of b must match")
+
+    @staticmethod
+    def create(
+        num_input_features: int,
+        num_output_features: int,
+        gamma: float,
+        seed: int = 0,
+    ) -> "CosineRandomFeatures":
+        """Gaussian W scaled by gamma, uniform b in [0, 2π)
+        (parity: CosineRandomFeatures.scala:49-61)."""
+        kw, kb = jax.random.split(jax.random.PRNGKey(seed))
+        W = gamma * jax.random.normal(
+            kw, (num_output_features, num_input_features), dtype=jnp.float32
+        )
+        b = 2 * math.pi * jax.random.uniform(
+            kb, (num_output_features,), dtype=jnp.float32
+        )
+        return CosineRandomFeatures(W, b)
+
+    def trace_batch(self, X):
+        return jnp.cos(X @ self.W.T + self.b)
+
+
+@jax.jit
+def _column_stats(X):
+    # Sample variance (ddof=1), matching MultivariateOnlineSummarizer.
+    return jnp.mean(X, axis=0), jnp.var(X, axis=0, ddof=1)
+
+
+class StandardScalerModel(Transformer):
+    """(x − mean) / std; std of None means center-only
+    (parity: StandardScaler.scala:16-32)."""
+
+    def __init__(self, mean, std=None):
+        self.mean = jnp.asarray(mean)
+        self.std = None if std is None else jnp.asarray(std)
+
+    def trace_batch(self, X):
+        out = X - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+
+class StandardScaler(Estimator):
+    """Fit column mean/std; degenerate stds (0/NaN/inf) become 1.0
+    (parity: StandardScaler.scala:38-61). The treeAggregate summarizer
+    collapses to jnp.mean/var — psum over the mesh when sharded."""
+
+    def __init__(self, normalize_std_dev: bool = True, eps: float = 1e-12):
+        self.normalize_std_dev = normalize_std_dev
+        self.eps = eps
+
+    def fit(self, data: Dataset) -> StandardScalerModel:
+        X = data.to_array()
+        mean, var = _column_stats(X)
+        if not self.normalize_std_dev:
+            return StandardScalerModel(mean, None)
+        std = jnp.sqrt(var)
+        bad = jnp.isnan(std) | jnp.isinf(std) | (jnp.abs(std) < self.eps)
+        std = jnp.where(bad, 1.0, std)
+        return StandardScalerModel(mean, std)
+
+
+class Sampler(Transformer):
+    """Deterministic-seed sample of ``size`` rows without replacement
+    (parity: Sampling.scala:28-33 takeSample). Operates dataset→dataset."""
+
+    def __init__(self, size: int, seed: int = 42):
+        self.size = size
+        self.seed = seed
+
+    def apply_batch(self, data: Dataset) -> Dataset:
+        data = Dataset.of(data)
+        n = len(data)
+        k = min(self.size, n)
+        idx = np.random.default_rng(self.seed).choice(n, size=k, replace=False)
+        if data.is_batched:
+            X = data.to_array()
+            return Dataset(X[jnp.asarray(np.sort(idx))], batched=True)
+        items = data.collect()
+        return Dataset.from_items([items[i] for i in np.sort(idx)])
+
+    def apply(self, x):
+        return x
+
+
+class ColumnSampler(Transformer):
+    """Sample ``num_samples`` random columns of each (d, m) matrix item
+    (parity: Sampling.scala:12-20). Used to subsample descriptor matrices
+    before PCA/GMM estimation."""
+
+    def __init__(self, num_samples_per_matrix: int, seed: int = 0):
+        self.num_samples = num_samples_per_matrix
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+
+    def apply(self, x):
+        x = jnp.asarray(x)
+        cols = self._rng.integers(0, x.shape[1], size=self.num_samples)
+        return x[:, jnp.asarray(cols)]

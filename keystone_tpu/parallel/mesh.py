@@ -51,7 +51,7 @@ def make_mesh(
     if n_data is None:
         n_data = len(devices) // n_model
     use = n_data * n_model
-    if use > len(devices):
+    if use > len(devices) or n_data < 1 or n_model < 1:
         raise ValueError(
             f"mesh {n_data}x{n_model} needs {use} devices, have {len(devices)}"
         )

@@ -9,10 +9,14 @@ import os
 # the 8-device virtual mesh, and the single real chip can't provide it.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force exactly 8 virtual devices (tests assert on the mesh size); strip any
+# pre-existing count the outer environment may have set.
+flags = " ".join(
+    f for f in flags.split() if "xla_force_host_platform_device_count" not in f
+)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
 
 import jax  # noqa: E402
 

@@ -1,0 +1,136 @@
+"""Numerical-oracle tests for stats/util nodes vs numpy/scipy — the reference's
+cross-implementation oracle family (SURVEY §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.stats import (
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    SignedHellingerMapper,
+    StandardScaler,
+)
+from keystone_tpu.nodes.util import (
+    ClassLabelIndicators,
+    MatrixVectorizer,
+    MaxClassifier,
+    MultiClassLabelIndicators,
+    TopKClassifier,
+    VectorCombiner,
+    VectorSplitter,
+)
+
+
+def test_padded_fft_matches_numpy():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((4, 784)).astype(np.float32)
+    out = np.asarray(PaddedFFT().apply_batch(Dataset.of(X)).to_array())
+    # oracle: numpy full FFT of zero-padded input, real part of first half
+    padded = np.zeros((4, 1024), dtype=np.float32)
+    padded[:, :784] = X
+    expected = np.real(np.fft.fft(padded, axis=1))[:, :512]
+    assert out.shape == (4, 512)
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-2)
+
+
+def test_padded_fft_pow2_input_not_padded():
+    X = np.ones((2, 512), dtype=np.float32)
+    out = PaddedFFT().apply_batch(Dataset.of(X)).to_array()
+    assert out.shape == (2, 256)
+
+
+def test_random_sign_node():
+    node = RandomSignNode.create(16, seed=3)
+    signs = np.asarray(node.signs)
+    assert set(np.unique(signs)) <= {-1.0, 1.0}
+    X = np.arange(16, dtype=np.float32)[None]
+    np.testing.assert_allclose(
+        np.asarray(node.apply_batch(Dataset.of(X)).to_array()), X * signs
+    )
+
+
+def test_linear_rectifier():
+    X = np.array([[-1.0, 0.5, 2.0]], dtype=np.float32)
+    out = LinearRectifier(0.0, 1.0).apply_batch(Dataset.of(X)).to_array()
+    np.testing.assert_allclose(np.asarray(out), [[0.0, 0.0, 1.0]])
+
+
+def test_cosine_random_features():
+    rng = np.random.default_rng(1)
+    W = rng.standard_normal((8, 5)).astype(np.float32)
+    b = rng.standard_normal(8).astype(np.float32)
+    X = rng.standard_normal((6, 5)).astype(np.float32)
+    out = CosineRandomFeatures(W, b).apply_batch(Dataset.of(X)).to_array()
+    np.testing.assert_allclose(
+        np.asarray(out), np.cos(X @ W.T + b), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_standard_scaler_matches_numpy():
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((40, 7)).astype(np.float32) * 3 + 1
+    model = StandardScaler().fit(Dataset.of(X))
+    out = np.asarray(model.apply_batch(Dataset.of(X)).to_array())
+    expected = (X - X.mean(axis=0)) / X.std(axis=0, ddof=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_standard_scaler_zero_variance_column():
+    X = np.ones((10, 3), dtype=np.float32)
+    model = StandardScaler().fit(Dataset.of(X))
+    out = np.asarray(model.apply_batch(Dataset.of(X)).to_array())
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_normalize_rows_and_hellinger():
+    X = np.array([[3.0, -4.0], [0.0, 0.0]], dtype=np.float32)
+    out = np.asarray(NormalizeRows().apply_batch(Dataset.of(X)).to_array())
+    np.testing.assert_allclose(out[0], [0.6, -0.8], rtol=1e-5)
+    np.testing.assert_allclose(out[1], [0.0, 0.0])
+    h = np.asarray(
+        SignedHellingerMapper().apply_batch(Dataset.of(X)).to_array()
+    )
+    np.testing.assert_allclose(h[0], [np.sqrt(3), -2.0], rtol=1e-5)
+
+
+def test_class_label_indicators():
+    y = np.array([0, 2], dtype=np.int32)
+    out = np.asarray(
+        ClassLabelIndicators(3).apply_batch(Dataset.of(y)).to_array()
+    )
+    np.testing.assert_allclose(out, [[1, -1, -1], [-1, -1, 1]])
+
+
+def test_multi_class_label_indicators():
+    out = np.asarray(MultiClassLabelIndicators(4).apply([1, 3]))
+    np.testing.assert_allclose(out, [-1, 1, -1, 1])
+
+
+def test_max_and_topk_classifier():
+    X = np.array([[0.1, 0.9, 0.5], [2.0, -1.0, 0.0]], dtype=np.float32)
+    preds = np.asarray(MaxClassifier().apply_batch(Dataset.of(X)).to_array())
+    np.testing.assert_array_equal(preds, [1, 0])
+    topk = np.asarray(TopKClassifier(2).apply_batch(Dataset.of(X)).to_array())
+    np.testing.assert_array_equal(topk, [[1, 2], [0, 2]])
+
+
+def test_vector_splitter_and_combiner_roundtrip():
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((5, 10)).astype(np.float32)
+    blocks = VectorSplitter(4).split_batch(X)
+    assert [b.shape[1] for b in blocks] == [4, 4, 2]
+    ds = Dataset(tuple(blocks), batched=True)
+    out = np.asarray(VectorCombiner().apply_batch(ds).to_array())
+    np.testing.assert_allclose(out, X)
+
+
+def test_matrix_vectorizer_column_major():
+    X = np.arange(6, dtype=np.float32).reshape(1, 2, 3)
+    out = np.asarray(MatrixVectorizer().apply_batch(Dataset.of(X)).to_array())
+    # column-major flatten of [[0,1,2],[3,4,5]] is [0,3,1,4,2,5]
+    np.testing.assert_allclose(out, [[0, 3, 1, 4, 2, 5]])

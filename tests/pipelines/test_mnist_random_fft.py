@@ -1,0 +1,70 @@
+"""End-to-end MnistRandomFFT on synthetic data — the minimum slice of
+SURVEY §7 step 3 and BASELINE metric #1, run small on the CPU mesh."""
+
+import numpy as np
+
+from keystone_tpu.evaluation.multiclass import MulticlassClassifierEvaluator
+from keystone_tpu.nodes.learning.linear import (
+    BlockLeastSquaresEstimator,
+    LinearMapEstimator,
+)
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.pipelines.mnist_random_fft import (
+    MnistRandomFFTConfig,
+    run,
+    synthetic_mnist,
+)
+
+
+def test_mnist_random_fft_end_to_end():
+    train, test = synthetic_mnist(n_train=1024, n_test=256, seed=7)
+    conf = MnistRandomFFTConfig(num_ffts=2, block_size=512, lam=10.0)
+    pipeline, train_err, test_err, seconds = run(train, test, conf)
+    # Synthetic classes are linearly separable-ish after FFT features; the
+    # pipeline must do far better than chance (90% error).
+    assert train_err < 0.15, f"train error {train_err}"
+    assert test_err < 0.35, f"test error {test_err}"
+
+
+def test_block_solver_multiblock_agrees_with_exact():
+    """BlockLeastSquares with several blocks and iterations ≈ exact OLS
+    (parity: BlockLinearMapperSuite.scala:19-56)."""
+    rng = np.random.default_rng(0)
+    n, d, k = 256, 32, 3
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    W = rng.standard_normal((d, k)).astype(np.float32)
+    Y = X @ W + 0.01 * rng.standard_normal((n, k)).astype(np.float32)
+
+    exact = LinearMapEstimator(lam=0.01).fit(Dataset.of(X), Dataset.of(Y))
+    block = BlockLeastSquaresEstimator(8, 20, lam=0.01).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    pe = np.asarray(exact.apply_batch(Dataset.of(X)).to_array())
+    pb = np.asarray(block.apply_batch(Dataset.of(X)).to_array())
+    np.testing.assert_allclose(pb, pe, rtol=1e-2, atol=1e-2)
+
+
+def test_block_solver_apply_blocks_matches_fused():
+    rng = np.random.default_rng(1)
+    n, d, k = 64, 12, 2
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    model = BlockLeastSquaresEstimator(4, 2, lam=0.1).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    fused = np.asarray(model.apply_batch(Dataset.of(X)).to_array())
+    blocks = [X[:, i : i + 4] for i in range(0, d, 4)]
+    via_blocks = np.asarray(model.apply_blocks(blocks))
+    np.testing.assert_allclose(via_blocks, fused, rtol=1e-4, atol=1e-4)
+
+
+def test_multiclass_evaluator_metrics():
+    ev = MulticlassClassifierEvaluator(3)
+    preds = [0, 1, 2, 2, 1, 0]
+    actual = [0, 1, 1, 2, 1, 2]
+    m = ev.evaluate(preds, actual)
+    assert m.confusion_matrix.sum() == 6
+    assert m.confusion_matrix[0, 0] == 1  # actual 0 predicted 0
+    assert m.confusion_matrix[1, 2] == 1  # actual 1 predicted 2
+    assert abs(m.total_accuracy - 4 / 6) < 1e-9
+    assert abs(m.total_error - 2 / 6) < 1e-9
