@@ -27,8 +27,7 @@ import jax.numpy as jnp
 from .row_matrix import solve_spd
 
 
-@partial(jax.jit, donate_argnums=(2,))
-def _block_update(
+def _block_update_impl(
     Aj: jax.Array,
     Wj_old: jax.Array,
     pred: jax.Array,
@@ -46,6 +45,19 @@ def _block_update(
     Wj = solve_spd(G, c, reg)
     pred = pred + Aj @ (Wj - Wj_old)
     return Wj, pred
+
+
+# Donate the prediction buffer on accelerators (in-place HBM update per
+# block). On the CPU backend donation intermittently aborts the process
+# (observed under the 8-device virtual mesh), so plain jit there.
+_block_update_donating = jax.jit(_block_update_impl, donate_argnums=(2,))
+_block_update_plain = jax.jit(_block_update_impl)
+
+
+def _block_update(Aj, Wj_old, pred, y, reg):
+    if jax.default_backend() == "cpu":
+        return _block_update_plain(Aj, Wj_old, pred, y, reg)
+    return _block_update_donating(Aj, Wj_old, pred, y, reg)
 
 
 def solve_blockwise_l2(

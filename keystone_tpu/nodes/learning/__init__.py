@@ -1,4 +1,5 @@
 from .cost import CostModel
+from .zca import ZCAWhitener, ZCAWhitenerEstimator
 from .linear import (
     BlockLeastSquaresEstimator,
     BlockLinearMapper,
@@ -12,4 +13,6 @@ __all__ = [
     "BlockLinearMapper",
     "LinearMapEstimator",
     "LinearMapper",
+    "ZCAWhitener",
+    "ZCAWhitenerEstimator",
 ]

@@ -1,0 +1,172 @@
+"""RandomPatchCifar — CIFAR-10 with random-patch convolutional features.
+
+Parity: pipelines/images/cifar/RandomPatchCifar.scala:18-120. Stages:
+sample patches (Windower → vectorize → Sampler) → normalize + ZCA-whiten →
+random filter bank → Convolver (whitened, patch-normalized) →
+SymmetricRectifier → sum-Pooler → vectorize → StandardScaler →
+BlockLeastSquaresEstimator → MaxClassifier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..evaluation.multiclass import MulticlassClassifierEvaluator
+from ..loaders.cifar import NCHAN, NROW, load_cifar, synthetic_cifar
+from ..loaders.csv_loader import LabeledData
+from ..nodes.images.core import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    SymmetricRectifier,
+    Windower,
+)
+from ..nodes.learning.linear import BlockLeastSquaresEstimator
+from ..nodes.learning.zca import ZCAWhitenerEstimator
+from ..nodes.stats import Sampler, StandardScaler
+from ..nodes.util import ClassLabelIndicators, MaxClassifier
+from ..utils.stats import normalize_rows
+
+NUM_CLASSES = 10
+
+
+@dataclass
+class RandomCifarConfig:
+    """Parity: RandomCifarConfig (RandomPatchCifar.scala:89-100)."""
+
+    train_location: str = ""
+    test_location: str = ""
+    num_filters: int = 100
+    whitening_epsilon: float = 0.1
+    patch_size: int = 6
+    patch_steps: int = 1
+    pool_size: int = 14
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: Optional[float] = None
+    sample_frac: Optional[float] = None
+    whitener_size: int = 100000
+    seed: int = 0
+
+
+def learn_filters(train_images: Dataset, conf: RandomCifarConfig):
+    """Sample patches, whiten, pick + scale random filters
+    (parity: RandomPatchCifar.scala:41-58). Returns (filters, whitener)."""
+    patch_extractor = (
+        Windower(conf.patch_steps, conf.patch_size)
+        .and_then(ImageVectorizer())
+        .and_then(Sampler(conf.whitener_size, seed=conf.seed))
+    )
+    base = patch_extractor(train_images).get().to_array()
+    base_mat = normalize_rows(jnp.asarray(base), 10.0)
+    whitener = ZCAWhitenerEstimator(conf.whitening_epsilon).fit_single(base_mat)
+
+    rng = np.random.default_rng(conf.seed)
+    idx = rng.choice(
+        base_mat.shape[0],
+        size=min(conf.num_filters, base_mat.shape[0]),
+        replace=False,
+    )
+    sample = base_mat[jnp.asarray(np.sort(idx))]
+    unnorm = whitener.transform(sample)
+    norms = jnp.sqrt(jnp.sum(unnorm * unnorm, axis=1))
+    filters = (unnorm / (norms + 1e-10)[:, None]) @ whitener.whitener.T
+    return filters, whitener
+
+
+def build_pipeline(train: LabeledData, conf: RandomCifarConfig):
+    labels = ClassLabelIndicators(NUM_CLASSES).apply_batch(train.labels)
+    filters, whitener = learn_filters(train.data, conf)
+    featurizer = (
+        Convolver(
+            filters, NROW, NROW, NCHAN, whitener=whitener,
+            normalize_patches=True,
+        )
+        .and_then(SymmetricRectifier(alpha=conf.alpha))
+        .and_then(Pooler(conf.pool_stride, conf.pool_size, None, "sum"))
+        .and_then(ImageVectorizer())
+    )
+    return featurizer.and_then(
+        StandardScaler(), train.data
+    ).and_then(
+        BlockLeastSquaresEstimator(4096, 1, conf.lam or 0.0),
+        train.data,
+        labels,
+    ).and_then(MaxClassifier())
+
+
+def run(train: LabeledData, test: LabeledData, conf: RandomCifarConfig):
+    start = time.perf_counter()
+    if conf.sample_frac is not None:
+        # parity: RandomPatchCifar.scala:29-32 (sample training data)
+        rng = np.random.default_rng(conf.seed)
+        n = len(train)
+        keep = np.sort(
+            rng.choice(n, size=max(1, int(n * conf.sample_frac)), replace=False)
+        )
+        train = LabeledData(
+            np.asarray(train.labels.to_array())[keep],
+            np.asarray(train.data.to_array())[keep],
+        )
+    pipeline = build_pipeline(train, conf)
+    fitted = pipeline.fit()
+    ev = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = ev.evaluate(
+        fitted.apply_compiled(train.data.to_array()), train.labels
+    )
+    test_eval = ev.evaluate(
+        fitted.apply_compiled(test.data.to_array()), test.labels
+    )
+    return pipeline, train_eval.total_error, test_eval.total_error, \
+        time.perf_counter() - start
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("RandomPatchCifar")
+    p.add_argument("--trainLocation", default=None)
+    p.add_argument("--testLocation", default=None)
+    p.add_argument("--numFilters", type=int, default=100)
+    p.add_argument("--whiteningEpsilon", type=float, default=0.1)
+    p.add_argument("--patchSize", type=int, default=6)
+    p.add_argument("--patchSteps", type=int, default=1)
+    p.add_argument("--poolSize", type=int, default=14)
+    p.add_argument("--poolStride", type=int, default=13)
+    p.add_argument("--alpha", type=float, default=0.25)
+    p.add_argument("--lambda", dest="lam", type=float, default=None)
+    p.add_argument("--nTrain", type=int, default=4096)
+    p.add_argument("--nTest", type=int, default=1024)
+    args = p.parse_args(argv)
+    conf = RandomCifarConfig(
+        num_filters=args.numFilters,
+        whitening_epsilon=args.whiteningEpsilon,
+        patch_size=args.patchSize,
+        patch_steps=args.patchSteps,
+        pool_size=args.poolSize,
+        pool_stride=args.poolStride,
+        alpha=args.alpha,
+        lam=args.lam,
+    )
+    if args.trainLocation:
+        if not args.testLocation:
+            p.error("--testLocation is required with --trainLocation")
+        train = load_cifar(args.trainLocation)
+        test = load_cifar(args.testLocation)
+    else:
+        train = synthetic_cifar(args.nTrain, seed=1)
+        test = synthetic_cifar(args.nTest, seed=2)
+    _, train_err, test_err, seconds = run(train, test, conf)
+    print(f"Training error is: {train_err}")
+    print(f"Test error is: {test_err}")
+    print(f"Pipeline took {seconds} s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

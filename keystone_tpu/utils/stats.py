@@ -1,0 +1,35 @@
+"""Stats helpers (parity: utils/Stats.scala)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def normalize_rows(mat, alpha: float = 1.0):
+    """Subtract each row's mean and divide by sqrt(row variance + alpha);
+    row variance uses ddof=1 (parity: Stats.normalizeRows,
+    utils/Stats.scala:112-123)."""
+    mat = jnp.asarray(mat)
+    means = jnp.nan_to_num(jnp.mean(mat, axis=1, keepdims=True))
+    var = jnp.sum((mat - means) ** 2, axis=1, keepdims=True) / (
+        mat.shape[1] - 1.0
+    )
+    sds = jnp.sqrt(var + alpha)
+    sds = jnp.where(jnp.isnan(sds), np.sqrt(alpha), sds)
+    return (mat - means) / sds
+
+
+def about_eq(a, b, thresh: float = 1e-8) -> bool:
+    """Max-abs-difference approximate equality
+    (parity: Stats.aboutEq, utils/Stats.scala:25-70)."""
+    return bool(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b))) < thresh)
+
+
+def classification_error(predicted, actual) -> float:
+    """Percent mismatches (parity: Stats.classificationError,
+    utils/Stats.scala:79-101)."""
+    p = np.asarray(predicted).ravel()
+    a = np.asarray(actual).ravel()
+    return float((p != a).mean() * 100.0)
