@@ -1,4 +1,5 @@
 from .base import Evaluator
+from .mean_average_precision import MeanAveragePrecisionEvaluator
 from .multiclass import MulticlassClassifierEvaluator, MulticlassMetrics
 
-__all__ = ["Evaluator", "MulticlassClassifierEvaluator", "MulticlassMetrics"]
+__all__ = ["Evaluator", "MeanAveragePrecisionEvaluator", "MulticlassClassifierEvaluator", "MulticlassMetrics"]

@@ -1,3 +1,8 @@
+from .daisy import DaisyExtractor
+from .fisher_vector import FisherVector, GMMFisherVectorEstimator
+from .hog import HogExtractor
+from .lcs import LCSExtractor
+from .sift import SIFTExtractor
 from .core import (
     CenterCornerPatcher,
     Convolver,
@@ -16,6 +21,12 @@ from .core import (
 )
 
 __all__ = [
+    "DaisyExtractor",
+    "FisherVector",
+    "GMMFisherVectorEstimator",
+    "HogExtractor",
+    "LCSExtractor",
+    "SIFTExtractor",
     "CenterCornerPatcher",
     "Convolver",
     "Cropper",

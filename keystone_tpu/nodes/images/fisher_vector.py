@@ -1,0 +1,100 @@
+"""Fisher Vector encoding (parity: nodes/images/FisherVector.scala:21-94 and
+the native enceval path external/FisherVector.scala:17 — the formula from
+Sanchez et al. IJCV'13; the JNI fast path is subsumed by running the same
+matrix algebra on the MXU).
+
+Input items are (d, n_desc) descriptor matrices; output (d, 2k) — first- and
+second-order statistics per mixture component.
+
+Note: the reference's fv2 line (FisherVector.scala:47) carries a stray ``.t``
+on the ``(μ²−σ²)·diag(s0)`` term that only type-checks when d == k; the
+published Sanchez et al. formula (and the enceval native implementation the
+reference validates against) scale per column by s0 — implemented as intended
+here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data.dataset import Dataset
+from ...workflow.transformer import Estimator, Transformer
+from ..learning.gmm import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+    _posteriors,
+)
+
+
+@jax.jit
+def _fisher_vector(X, means, variances, weights, weight_threshold):
+    """X: (n, d, m) batch of descriptor matrices; means/variances (d, k);
+    weights (k,). Returns (n, d, 2k)."""
+    n_desc = X.shape[-1]
+    # posteriors per descriptor: (n, m, k)
+    Xt = jnp.swapaxes(X, 1, 2)  # (n, m, d)
+    q = jax.vmap(
+        lambda xt: _posteriors(
+            xt, means.T, variances.T, weights, weight_threshold
+        )
+    )(Xt)
+    s0 = jnp.mean(q, axis=1)                       # (n, k)
+    s1 = jnp.einsum("ndm,nmk->ndk", X, q) / n_desc  # (n, d, k)
+    s2 = jnp.einsum("ndm,nmk->ndk", X * X, q) / n_desc
+
+    fv1 = (s1 - means * s0[:, None, :]) / (
+        jnp.sqrt(variances) * jnp.sqrt(weights)
+    )
+    fv2 = (
+        s2
+        - 2.0 * means * s1
+        + (means * means - variances) * s0[:, None, :]
+    ) / (variances * jnp.sqrt(2.0 * weights))
+    return jnp.concatenate([fv1, fv2], axis=-1)
+
+
+class FisherVector(Transformer):
+    """FV encoding transformer (parity: FisherVector, FisherVector.scala:21-55)."""
+
+    def __init__(self, gmm: GaussianMixtureModel):
+        self.gmm = gmm
+
+    def trace_batch(self, X):
+        return _fisher_vector(
+            X.astype(jnp.float32),
+            self.gmm.means.astype(jnp.float32),
+            self.gmm.variances.astype(jnp.float32),
+            self.gmm.weights.astype(jnp.float32),
+            self.gmm.weight_threshold,
+        )
+
+    def apply(self, x):
+        return self.trace_batch(jnp.asarray(x)[None])[0]
+
+
+class GMMFisherVectorEstimator(Estimator):
+    """Fit a GMM on descriptor columns, emit the FV transformer (parity:
+    ScalaGMMFisherVectorEstimator / GMMFisherVectorEstimator,
+    FisherVector.scala:66-94; the k≥32 native-vs-scala choice point vanishes —
+    there is one on-device implementation)."""
+
+    def __init__(self, k: int, **gmm_kwargs):
+        self.k = k
+        self.gmm_kwargs = gmm_kwargs
+
+    def fit(self, data: Dataset) -> FisherVector:
+        data = Dataset.of(data)
+        if data.is_batched:
+            X = jnp.asarray(data.to_array())
+            cols = jnp.transpose(X, (0, 2, 1)).reshape(-1, X.shape[1])
+        else:
+            import numpy as np
+
+            cols = jnp.asarray(
+                np.concatenate([np.asarray(i).T for i in data], axis=0)
+            )
+        gmm = GaussianMixtureModelEstimator(
+            self.k, **self.gmm_kwargs
+        ).fit_matrix(cols)
+        return FisherVector(gmm)

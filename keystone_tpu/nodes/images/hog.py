@@ -1,0 +1,163 @@
+"""Histogram-of-gradients features (Felzenszwalb voc-release5 variant).
+
+Parity: nodes/images/HogExtractor.scala:27-296 (itself a port of
+voc-dpm features.cc). The per-pixel loops become batched array ops: the
+bilinear scatter into cells exploits that cell indices and bilinear weights
+depend only on pixel *position* (static), while only the orientation snap and
+magnitude are data-dependent — so the histogram build is four
+``segment_sum``s over static segment ids.
+
+Output per image: (numXCells−2)·(numYCells−2) rows × 32 features, row index
+y + x·numYCellsWithFeatures — the reference's layout.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...workflow.transformer import Transformer
+
+_EPS = 1e-4
+_UU = np.array([1.0, 0.9397, 0.766, 0.5, 0.1736,
+                -0.1736, -0.5, -0.766, -0.9397])
+_VV = np.array([0.0, 0.342, 0.6428, 0.866, 0.9848,
+                0.9848, 0.866, 0.6428, 0.342])
+
+
+class HogExtractor(Transformer):
+    def __init__(self, bin_size: int):
+        self.bin_size = bin_size
+
+    def trace_batch(self, X):
+        """(n, X, Y, C) → (n, numCellsWithFeatures, 32)."""
+        X = jnp.asarray(X).astype(jnp.float32)
+        n, xd, yd, nc = X.shape
+        b = self.bin_size
+        # round half UP like Scala math.round (Python round() is banker's)
+        n_x = int(math.floor(xd / b + 0.5))
+        n_y = int(math.floor(yd / b + 0.5))
+        vis_x, vis_y = n_x * b, n_y * b
+
+        # pixel grid 1..vis-2 (the reference's loop bounds)
+        pxs = np.arange(1, vis_x - 1)
+        pys = np.arange(1, vis_y - 1)
+        P = len(pxs) * len(pys)
+
+        sub = X[:, : min(vis_x, xd), : min(vis_y, yd), :]
+        # pad if rounding made the visible area larger than the image
+        if vis_x > xd or vis_y > yd:
+            sub = jnp.pad(
+                sub,
+                ((0, 0), (0, max(0, vis_x - xd)), (0, max(0, vis_y - yd)),
+                 (0, 0)),
+                mode="edge",
+            )
+
+        dx = (sub[:, 2:, :, :] - sub[:, :-2, :, :])[:, :, 1:-1, :]
+        dy = (sub[:, :, 2:, :] - sub[:, :, :-2, :])[:, 1:-1, :, :]
+        mag_sq = dx * dx + dy * dy
+        best_c = jnp.argmax(mag_sq, axis=-1)  # ties: lowest idx (ref scans
+        # channels high→low with strict >, i.e. lowest wins ties too)
+        dx = jnp.take_along_axis(dx, best_c[..., None], axis=-1)[..., 0]
+        dy = jnp.take_along_axis(dy, best_c[..., None], axis=-1)[..., 0]
+        mag = jnp.sqrt(jnp.take_along_axis(
+            mag_sq, best_c[..., None], axis=-1)[..., 0])
+
+        uu = jnp.asarray(_UU, dtype=X.dtype)
+        vv = jnp.asarray(_VV, dtype=X.dtype)
+        dots = dy[..., None] * uu + dx[..., None] * vv  # (n, px, py, 9)
+        both = jnp.concatenate([dots, -dots], axis=-1)  # o and o+9
+        o_idx = jnp.argmax(both, axis=-1)               # (n, px, py)
+
+        # weighted orientation one-hots, flattened over pixels
+        contrib = jax.nn.one_hot(o_idx, 18, dtype=X.dtype) * mag[..., None]
+        contrib = contrib.reshape(n, P, 18)
+
+        # static bilinear geometry per pixel position
+        xp = (pxs + 0.5) / b - 0.5
+        yp = (pys + 0.5) / b - 0.5
+        ixp = np.floor(xp).astype(np.int64)
+        iyp = np.floor(yp).astype(np.int64)
+        vx0 = (xp - ixp)[:, None] * np.ones((1, len(pys)))
+        vy0 = np.ones((len(pxs), 1)) * (yp - iyp)[None, :]
+        IX = ixp[:, None] * np.ones((1, len(pys)), dtype=np.int64)
+        IY = np.ones((len(pxs), 1), dtype=np.int64) * iyp[None, :]
+
+        hist = jnp.zeros((n, n_x * n_y, 18), dtype=X.dtype)
+        corners = [
+            (IX, IY, (1 - vx0) * (1 - vy0)),
+            (IX, IY + 1, (1 - vx0) * vy0),
+            (IX + 1, IY, vx0 * (1 - vy0)),
+            (IX + 1, IY + 1, vx0 * vy0),
+        ]
+        for cx, cy, w in corners:
+            valid = (cx >= 0) & (cx < n_x) & (cy >= 0) & (cy < n_y)
+            seg = np.where(valid, cx + cy * n_x, n_x * n_y)  # invalid → bin
+            seg_flat = jnp.asarray(seg.reshape(P))
+            w_flat = jnp.asarray(
+                (w * valid).reshape(P, 1), dtype=X.dtype
+            )
+            summed = jax.ops.segment_sum(
+                jnp.einsum("npo,p->npo", contrib, w_flat[:, 0]).swapaxes(0, 1),
+                seg_flat,
+                num_segments=n_x * n_y + 1,
+            )  # (cells+1, n, 18)
+            hist = hist + jnp.swapaxes(summed[:-1], 0, 1)
+
+        # cell energies: sum over 9 of (h_o + h_{o+9})²
+        energy = jnp.sum(
+            (hist[..., :9] + hist[..., 9:]) ** 2, axis=-1
+        ).reshape(n, n_y, n_x)  # index [y, x] to mirror x + y·n_x layout
+
+        nxf, nyf = max(n_x - 2, 0), max(n_y - 2, 0)
+        if nxf == 0 or nyf == 0:
+            return jnp.zeros((n, 0, 32), dtype=X.dtype)
+
+        # block norms: 1/sqrt of 2×2 neighborhoods of cell energies
+        e2 = (
+            energy[:, :-1, :-1] + energy[:, :-1, 1:]
+            + energy[:, 1:, :-1] + energy[:, 1:, 1:]
+        )  # (n, n_y−1, n_x−1): sum of 2×2 block anchored at (y, x)
+        inv = 1.0 / jnp.sqrt(e2 + _EPS)
+        # n1..n4 for output cell (x, y) — anchored per the reference offsets
+        n1 = inv[:, 1 : 1 + nyf, 1 : 1 + nxf]
+        n2 = inv[:, 1 : 1 + nyf, 0:nxf]
+        n3 = inv[:, 0:nyf, 1 : 1 + nxf]
+        n4 = inv[:, 0:nyf, 0:nxf]
+
+        hist_g = hist.reshape(n, n_y, n_x, 18)
+        hcell = hist_g[:, 1 : 1 + nyf, 1 : 1 + nxf, :]  # (n, nyf, nxf, 18)
+
+        h1 = jnp.minimum(hcell * n1[..., None], 0.2)
+        h2 = jnp.minimum(hcell * n2[..., None], 0.2)
+        h3 = jnp.minimum(hcell * n3[..., None], 0.2)
+        h4 = jnp.minimum(hcell * n4[..., None], 0.2)
+        contrast_sensitive = 0.5 * (h1 + h2 + h3 + h4)
+        t1 = jnp.sum(h1, axis=-1)
+        t2 = jnp.sum(h2, axis=-1)
+        t3 = jnp.sum(h3, axis=-1)
+        t4 = jnp.sum(h4, axis=-1)
+
+        hsum = hcell[..., :9] + hcell[..., 9:]
+        i1 = jnp.minimum(hsum * n1[..., None], 0.2)
+        i2 = jnp.minimum(hsum * n2[..., None], 0.2)
+        i3 = jnp.minimum(hsum * n3[..., None], 0.2)
+        i4 = jnp.minimum(hsum * n4[..., None], 0.2)
+        contrast_insensitive = 0.5 * (i1 + i2 + i3 + i4)
+
+        texture = 0.2357 * jnp.stack([t1, t2, t3, t4], axis=-1)
+        zeros = jnp.zeros_like(t1)[..., None]
+        feats = jnp.concatenate(
+            [contrast_sensitive, contrast_insensitive, texture, zeros],
+            axis=-1,
+        )  # (n, nyf, nxf, 32)
+        # row index y + x·nyf → transpose to (x, y) then flatten
+        return jnp.swapaxes(feats, 1, 2).reshape(n, nxf * nyf, 32)
+
+    def apply(self, x):
+        return self.trace_batch(jnp.asarray(x)[None])[0]

@@ -1,0 +1,69 @@
+"""Local Color Statistics descriptors, batched.
+
+Parity: nodes/images/LCSExtractor.scala:25-130 — per-channel box-filter means
+and standard deviations of subPatchSize² windows, sampled at a neighborhood
+grid around each keypoint; values interleaved (mean, std) per neighbor per
+channel. The per-pixel loops become two box convs and static gathers.
+
+Output per image: (numLCSValues, numPoolsX·numPoolsY) with descriptor index
+x_idx · numPoolsY + y_idx, matching the reference layout.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...workflow.transformer import Transformer
+from .daisy import _sep_conv_same
+
+
+class LCSExtractor(Transformer):
+    def __init__(self, stride: int, stride_start: int, sub_patch_size: int):
+        self.stride = stride
+        self.stride_start = stride_start
+        self.sub_patch_size = sub_patch_size
+
+    def trace_batch(self, X):
+        """(n, X, Y, C) → (n, numLCSValues, numDesc)."""
+        X = jnp.asarray(X).astype(jnp.float32)
+        n, xd, yd, nc = X.shape
+        sp = self.sub_patch_size
+        ones = np.full(sp, 1.0 / sp)
+
+        kx = np.arange(self.stride_start, xd - self.stride_start, self.stride)
+        ky = np.arange(self.stride_start, yd - self.stride_start, self.stride)
+        npx, npy = len(kx), len(ky)
+
+        # neighborhood offsets (LCSExtractor.scala:41-47)
+        start = -2 * sp + sp // 2 - 1
+        end = sp + sp // 2 - 1
+        offsets = list(range(start, end + 1, sp))
+
+        # box means/stds per channel: (n, X, Y)
+        means_c, stds_c = [], []
+        for c in range(nc):
+            ch = X[..., c]
+            m = _sep_conv_same(ch, ones, ones)
+            sq = _sep_conv_same(ch * ch, ones, ones)
+            sd = jnp.sqrt(jnp.maximum(sq - m * m, 0.0))
+            means_c.append(m)
+            stds_c.append(sd)
+
+        cols = []  # feature rows in lcsIdx order: c slow, (nx, ny), (mean,std)
+        for c in range(nc):
+            for nx in offsets:
+                for ny in offsets:
+                    xs = jnp.asarray(np.clip(kx + nx, 0, xd - 1))
+                    ys = jnp.asarray(np.clip(ky + ny, 0, yd - 1))
+                    m = means_c[c][:, xs, :][:, :, ys].reshape(n, npx * npy)
+                    s = stds_c[c][:, xs, :][:, :, ys].reshape(n, npx * npy)
+                    cols.append(m)
+                    cols.append(s)
+        return jnp.stack(cols, axis=1)  # (n, numLCSValues, numDesc)
+
+    def apply(self, x):
+        return self.trace_batch(jnp.asarray(x)[None])[0]

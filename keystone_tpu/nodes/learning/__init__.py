@@ -1,18 +1,42 @@
 from .cost import CostModel
-from .zca import ZCAWhitener, ZCAWhitenerEstimator
+from .gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
+from .kmeans import KMeansModel, KMeansPlusPlusEstimator
 from .linear import (
     BlockLeastSquaresEstimator,
     BlockLinearMapper,
     LinearMapEstimator,
     LinearMapper,
 )
+from .pca import (
+    ApproximatePCAEstimator,
+    BatchPCATransformer,
+    ColumnPCAEstimator,
+    DistributedColumnPCAEstimator,
+    DistributedPCAEstimator,
+    LocalColumnPCAEstimator,
+    PCAEstimator,
+    PCATransformer,
+)
+from .zca import ZCAWhitener, ZCAWhitenerEstimator
 
 __all__ = [
     "CostModel",
+    "GaussianMixtureModel",
+    "GaussianMixtureModelEstimator",
+    "KMeansModel",
+    "KMeansPlusPlusEstimator",
     "BlockLeastSquaresEstimator",
     "BlockLinearMapper",
     "LinearMapEstimator",
     "LinearMapper",
+    "ApproximatePCAEstimator",
+    "BatchPCATransformer",
+    "ColumnPCAEstimator",
+    "DistributedColumnPCAEstimator",
+    "DistributedPCAEstimator",
+    "LocalColumnPCAEstimator",
+    "PCAEstimator",
+    "PCATransformer",
     "ZCAWhitener",
     "ZCAWhitenerEstimator",
 ]

@@ -1,0 +1,238 @@
+"""PCA family: local SVD, distributed TSQR, randomized sketch, and the
+cost-model chooser.
+
+Parity: nodes/learning/PCA.scala:19,38,118-160,163-226 (PCATransformer,
+BatchPCATransformer, ColumnPCAEstimator, PCAEstimator),
+DistributedPCA.scala:20 (TSQR-based), ApproximatePCA.scala:22,58
+(Halko/Martinsson/Tropp randomized range finder).
+
+"Column" estimators treat each item — a (d, n_desc) descriptor matrix — as
+n_desc separate d-vectors, matching the reference's matrixToColArray
+flattening.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...linalg.tsqr import tsqr_r
+from ...parallel.mesh import default_mesh
+from ...workflow.transformer import Estimator, Transformer
+from .cost import (
+    CostModel,
+    DEFAULT_CPU_WEIGHT,
+    DEFAULT_MEM_WEIGHT,
+    DEFAULT_NETWORK_WEIGHT,
+)
+
+
+def enforce_matlab_sign_convention(pca):
+    """Largest-|coefficient| element of each column gets a positive sign
+    (parity: PCAEstimator.enforceMatlabPCASignConvention, PCA.scala:228-247)."""
+    col_max = jnp.max(pca, axis=0)
+    abs_col_max = jnp.max(jnp.abs(pca), axis=0)
+    signs = jnp.where(col_max == abs_col_max, 1.0, -1.0)
+    return pca * signs
+
+
+class PCATransformer(Transformer):
+    """x → pcaMatᵀ x for d-vectors (parity: PCATransformer, PCA.scala:19-30).
+    ``pca_mat`` is (d, dims)."""
+
+    def __init__(self, pca_mat):
+        self.pca_mat = jnp.asarray(pca_mat)
+
+    def trace_batch(self, X):
+        return X @ self.pca_mat
+
+
+class BatchPCATransformer(Transformer):
+    """Per-item descriptor matrices (d, n_desc) → (dims, n_desc)
+    (parity: BatchPCATransformer, PCA.scala:38-44)."""
+
+    def __init__(self, pca_mat):
+        self.pca_mat = jnp.asarray(pca_mat)
+
+    def trace_batch(self, X):
+        # X: (n, d, n_desc) → (n, dims, n_desc)
+        return jnp.einsum("dk,ndm->nkm", self.pca_mat, X)
+
+    def apply(self, x):
+        return self.pca_mat.T @ jnp.asarray(x)
+
+
+@jax.jit
+def _pca_svd(X):
+    means = jnp.mean(X, axis=0)
+    _, _, vt = jnp.linalg.svd(X - means, full_matrices=False)
+    return enforce_matlab_sign_convention(vt.T)
+
+
+class PCAEstimator(Estimator, CostModel):
+    """Local SVD PCA over collected samples (parity: PCAEstimator,
+    PCA.scala:163-226; the direct sgesvd call becomes jnp.linalg.svd in f32)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    def fit(self, data: Dataset) -> PCATransformer:
+        X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+        return PCATransformer(self.compute_pca(X))
+
+    def compute_pca(self, X):
+        return _pca_svd(X)[:, : self.dims]
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        flops = n * d * d
+        return max(cpu_weight * flops, mem_weight * n * d) \
+            + network_weight * n * d
+
+
+class DistributedPCAEstimator(Estimator, CostModel):
+    """TSQR-based PCA: R factor over the mesh, then a d×d SVD of R
+    (parity: DistributedPCAEstimator, DistributedPCA.scala:20-74; the
+    per-partition QR + tree reduction becomes linalg.tsqr_r over ICI)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    def fit(self, data: Dataset) -> PCATransformer:
+        X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+        means = jnp.mean(X, axis=0)
+        R = tsqr_r(X - means, mesh=default_mesh())
+        _, _, vt = jnp.linalg.svd(R, full_matrices=False)
+        pca = enforce_matlab_sign_convention(vt.T)
+        return PCATransformer(pca[:, : self.dims])
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        import math
+
+        log2m = math.log2(max(num_machines, 2))
+        flops = n * d * d / num_machines + d * d * d * log2m
+        return max(cpu_weight * flops, mem_weight * n * d) \
+            + network_weight * d * d * log2m
+
+
+class ApproximatePCAEstimator(Estimator):
+    """Randomized sketch PCA, HMT 2011 algorithms 4.4 + 5.1
+    (parity: ApproximatePCAEstimator, ApproximatePCA.scala:22-105)."""
+
+    def __init__(self, dims: int, q: int = 10, p: int = 5, seed: int = 0):
+        self.dims = dims
+        self.q = q
+        self.p = p
+        self.seed = seed
+
+    def fit(self, data: Dataset) -> PCATransformer:
+        X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+        return PCATransformer(self._approximate_pca(X))
+
+    def _approximate_pca(self, A):
+        k, p, q = self.dims, self.p, self.q
+        n, d = A.shape
+        key = jax.random.PRNGKey(self.seed)
+        omega = jax.random.normal(key, (d, k + p), dtype=A.dtype)
+        means = jnp.mean(A, axis=0)
+        A = A - means
+        Q, _ = jnp.linalg.qr(A @ omega)
+        for _ in range(q):
+            Qh, _ = jnp.linalg.qr(A.T @ Q)
+            Q, _ = jnp.linalg.qr(A @ Qh)
+        B = Q.T @ A
+        _, _, vt = jnp.linalg.svd(B, full_matrices=False)
+        pca = enforce_matlab_sign_convention(vt.T)
+        return pca[:, :k]
+
+
+class _ColumnFit:
+    """Mixin: flatten per-item (d, n_desc) matrices into sample rows."""
+
+    @staticmethod
+    def _collect_columns(data: Dataset):
+        data = Dataset.of(data)
+        if data.is_batched:
+            X = jnp.asarray(data.to_array())
+            # (n, d, m) → (n·m, d)
+            return jnp.transpose(X, (0, 2, 1)).reshape(-1, X.shape[1])
+        cols = [np.asarray(item).T for item in data]
+        return jnp.asarray(np.concatenate(cols, axis=0), dtype=jnp.float32)
+
+
+class LocalColumnPCAEstimator(Estimator, CostModel, _ColumnFit):
+    """(parity: LocalColumnPCAEstimator, PCA.scala:52-73)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+        self._est = PCAEstimator(dims)
+
+    def fit(self, data: Dataset) -> BatchPCATransformer:
+        rows = self._collect_columns(data)
+        return BatchPCATransformer(self._est.compute_pca(rows))
+
+    def cost(self, *a):
+        return self._est.cost(*a)
+
+
+class DistributedColumnPCAEstimator(Estimator, CostModel, _ColumnFit):
+    """(parity: DistributedColumnPCAEstimator, PCA.scala:81-103)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+        self._est = DistributedPCAEstimator(dims)
+
+    def fit(self, data: Dataset) -> BatchPCATransformer:
+        rows = self._collect_columns(data)
+        t = self._est.fit(Dataset.of(rows))
+        return BatchPCATransformer(t.pca_mat)
+
+    def cost(self, *a):
+        return self._est.cost(*a)
+
+
+class ColumnPCAEstimator(Estimator, _ColumnFit):
+    """Cost-model chooser between local and distributed column PCA
+    (parity: ColumnPCAEstimator, PCA.scala:105-160). Falls back to the local
+    estimator when no sample statistics are available."""
+
+    def __init__(
+        self,
+        dims: int,
+        num_machines: Optional[int] = None,
+        cpu_weight: float = DEFAULT_CPU_WEIGHT,
+        mem_weight: float = DEFAULT_MEM_WEIGHT,
+        network_weight: float = DEFAULT_NETWORK_WEIGHT,
+    ):
+        self.dims = dims
+        self.num_machines = num_machines
+        self.cpu_weight = cpu_weight
+        self.mem_weight = mem_weight
+        self.network_weight = network_weight
+        self.local = LocalColumnPCAEstimator(dims)
+        self.distributed = DistributedColumnPCAEstimator(dims)
+
+    def optimize(self, sample: Dataset, num_per_partition=None) -> Estimator:
+        sample = Dataset.of(sample)
+        # shapes only — no device→host materialization of the descriptors
+        if sample.is_batched:
+            shape = jax.tree_util.tree_leaves(sample.payload)[0].shape
+            d, n = shape[1], shape[0] * shape[2]
+        else:
+            items = sample.payload
+            d = items[0].shape[0]
+            n = sum(item.shape[1] for item in items)
+        machines = self.num_machines or default_mesh().size
+        args = (n, d, self.dims, 1.0, machines,
+                self.cpu_weight, self.mem_weight, self.network_weight)
+        if self.local.cost(*args) <= self.distributed.cost(*args):
+            return self.local
+        return self.distributed
+
+    def fit(self, data: Dataset) -> BatchPCATransformer:
+        return self.optimize(data).fit(data)

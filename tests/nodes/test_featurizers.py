@@ -1,0 +1,231 @@
+"""Tests for the dense featurizers (SIFT, DAISY, LCS, HOG) — the TPU-native
+replacements for the reference's VLFeat/enceval native code and ported
+MATLAB. Oracles: naive numpy reimplementations on tiny inputs plus
+structural/invariance properties."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.images.daisy import DaisyExtractor
+from keystone_tpu.nodes.images.hog import HogExtractor
+from keystone_tpu.nodes.images.lcs import LCSExtractor
+from keystone_tpu.nodes.images.sift import SIFTExtractor
+
+
+def _rand_gray(rng, n=2, x=48, y=48):
+    return rng.random((n, x, y, 1)).astype(np.float32)
+
+
+# --------------------------------------------------------------- SIFT
+
+
+def test_sift_shapes_and_range():
+    rng = np.random.default_rng(0)
+    imgs = _rand_gray(rng)
+    ext = SIFTExtractor(step=4, bin_size=4, num_scales=2)
+    out = np.asarray(ext.trace_batch(jnp.asarray(imgs)))
+    assert out.shape[1] == 128
+    assert out.shape[0] == 2
+    assert out.min() >= 0.0 and out.max() <= 255.0
+    # descriptors quantized to integers
+    np.testing.assert_allclose(out, np.round(out))
+
+
+def test_sift_flat_image_zeroed_by_contrast_threshold():
+    imgs = 0.5 * np.ones((1, 40, 40, 1), dtype=np.float32)
+    out = np.asarray(SIFTExtractor(step=4, bin_size=4, num_scales=1).trace_batch(jnp.asarray(imgs)))
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_sift_translation_consistency():
+    """Shifting the image by one grid step shifts descriptors accordingly —
+    dense grid extraction is translation-covariant (up to edges)."""
+    rng = np.random.default_rng(1)
+    base = rng.random((56, 56)).astype(np.float32)
+    step = 4
+    ext = SIFTExtractor(step=step, bin_size=4, num_scales=1)
+    a = np.asarray(ext.descriptors_batch(jnp.asarray(base[None, :, :, None])))
+    shifted = np.roll(base, -step, axis=0)
+    b = np.asarray(ext.descriptors_batch(jnp.asarray(shifted[None, :, :, None])))
+    # grid is (gx, gy) x-major; dropping the first row of a's grid should
+    # match b's all-but-last row
+    extent = 4 * 4
+    gx = len(range(0, 56 - extent + 1, step))
+    gy = gx
+    a_grid = a.reshape(gx, gy, 128)
+    b_grid = b.reshape(gx, gy, 128)
+    # interior rows only (borders differ from roll wraparound)
+    close = np.isclose(a_grid[2:-1], b_grid[1:-2], atol=2.0)
+    assert close.mean() > 0.95
+
+
+# --------------------------------------------------------------- DAISY
+
+
+def test_daisy_shape_and_normalization():
+    rng = np.random.default_rng(2)
+    imgs = _rand_gray(rng, n=2, x=48, y=48)
+    ext = DaisyExtractor()
+    out = np.asarray(ext.trace_batch(jnp.asarray(imgs)))
+    assert out.shape[0] == 2
+    assert out.shape[1] == ext.feature_size == 8 * (8 * 3 + 1)
+    # each histogram sub-block is unit-norm or zero
+    h = ext.H
+    for block in range(out.shape[1] // h):
+        norms = np.linalg.norm(
+            out[0, block * h : (block + 1) * h, :], axis=0
+        )
+        ok = (np.abs(norms - 1.0) < 1e-3) | (norms < 1e-6)
+        assert ok.all()
+
+
+def test_daisy_desc_count_matches_grid():
+    imgs = np.zeros((1, 64, 52, 1), dtype=np.float32)
+    ext = DaisyExtractor(pixel_border=16, stride=4)
+    out = np.asarray(ext.trace_batch(jnp.asarray(imgs)))
+    nx = len(range(16, 64 - 16, 4))
+    ny = len(range(16, 52 - 16, 4))
+    assert out.shape[2] == nx * ny
+
+
+# --------------------------------------------------------------- LCS
+
+
+def test_lcs_matches_naive_numpy():
+    rng = np.random.default_rng(3)
+    img = rng.random((1, 40, 40, 2)).astype(np.float32)
+    sp = 3
+    ext = LCSExtractor(stride=6, stride_start=12, sub_patch_size=sp)
+    out = np.asarray(ext.trace_batch(jnp.asarray(img)))
+
+    # naive box mean/std ("same" zero-padded box filter of 1/sp per axis)
+    def box_same(a):
+        k = np.full(sp, 1.0 / sp)
+        pad = (sp - 1) // 2, sp - 1 - (sp - 1) // 2
+        ap = np.pad(a, ((pad[0], pad[1]), (0, 0)))
+        col = np.stack(
+            [ap[i : i + a.shape[0]] for i in range(sp)], axis=0
+        ).transpose(1, 2, 0) @ k
+        ap2 = np.pad(col, ((0, 0), (pad[0], pad[1])))
+        return np.stack(
+            [ap2[:, i : i + a.shape[1]] for i in range(sp)], axis=0
+        ).transpose(1, 2, 0) @ k
+
+    kx = list(range(12, 40 - 12, 6))
+    offsets = list(range(-2 * sp + sp // 2 - 1, sp + sp // 2, sp))
+    c, nx, ny = 0, offsets[0], offsets[1]
+    m = box_same(img[0, :, :, c])
+    sq = box_same(img[0, :, :, c] ** 2)
+    sd = np.sqrt(np.maximum(sq - m * m, 0))
+    # feature row index for (c=0, nx_idx=0, ny_idx=1, mean) = (0*16+0*4+1)*2
+    row_mean = (0 * len(offsets) ** 2 + 0 * len(offsets) + 1) * 2
+    desc0 = 0  # keypoint (kx[0], kx[0])
+    np.testing.assert_allclose(
+        out[0, row_mean, desc0], m[kx[0] + nx, kx[0] + ny], rtol=1e-4,
+        atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        out[0, row_mean + 1, desc0], sd[kx[0] + nx, kx[0] + ny], rtol=1e-3,
+        atol=1e-3,
+    )
+    assert out.shape == (1, len(offsets) ** 2 * 2 * 2, len(kx) ** 2)
+
+
+# --------------------------------------------------------------- HOG
+
+
+def _hog_naive(img, b):
+    """Direct transcription of HogExtractor.scala for the oracle."""
+    xd, yd, nc = img.shape
+    n_x, n_y = round(xd / b), round(yd / b)
+    hist = np.zeros(n_x * n_y * 18)
+    uu = np.array([1.0, 0.9397, 0.766, 0.5, 0.1736,
+                   -0.1736, -0.5, -0.766, -0.9397])
+    vv = np.array([0.0, 0.342, 0.6428, 0.866, 0.9848,
+                   0.9848, 0.866, 0.6428, 0.342])
+    for x in range(1, n_x * b - 1):
+        for y in range(1, n_y * b - 1):
+            best = (-np.inf, 0, 0)
+            for c in reversed(range(nc)):
+                dx = img[x + 1, y, c] - img[x - 1, y, c]
+                dy = img[x, y + 1, c] - img[x, y - 1, c]
+                if dx * dx + dy * dy > best[0]:
+                    best = (dx * dx + dy * dy, dx, dy)
+            msq, dx, dy = best
+            mag = math.sqrt(msq)
+            bo, bi = 0.0, 0
+            for o in range(9):
+                dot = uu[o] * dy + vv[o] * dx
+                if dot > bo:
+                    bo, bi = dot, o
+                elif -dot > bo:
+                    bo, bi = -dot, o + 9
+            xp = (x + 0.5) / b - 0.5
+            yp = (y + 0.5) / b - 0.5
+            ixp, iyp = math.floor(xp), math.floor(yp)
+            vx0, vy0 = xp - ixp, yp - iyp
+            for (cx, cy, w) in [
+                (ixp, iyp, (1 - vx0) * (1 - vy0)),
+                (ixp, iyp + 1, (1 - vx0) * vy0),
+                (ixp + 1, iyp, vx0 * (1 - vy0)),
+                (ixp + 1, iyp + 1, vx0 * vy0),
+            ]:
+                if 0 <= cx < n_x and 0 <= cy < n_y:
+                    hist[cx + cy * n_x + bi * n_x * n_y] += w * mag
+    return hist, n_x, n_y
+
+
+def test_hog_hist_matches_naive():
+    rng = np.random.default_rng(4)
+    img = rng.random((16, 16, 3)).astype(np.float32)
+    b = 4
+    hist_naive, n_x, n_y = _hog_naive(img.astype(np.float64), b)
+
+    ext = HogExtractor(b)
+    out = np.asarray(ext.trace_batch(jnp.asarray(img[None])))
+    nxf, nyf = n_x - 2, n_y - 2
+    assert out.shape == (1, nxf * nyf, 32)
+
+    # oracle the full feature pipeline from the naive hist
+    hist = hist_naive
+    norm = np.zeros(n_x * n_y)
+    for o in range(9):
+        v = hist[o * n_x * n_y : (o + 1) * n_x * n_y] + hist[
+            (o + 9) * n_x * n_y : (o + 10) * n_x * n_y
+        ]
+        norm += v * v
+    feats = np.zeros((nxf * nyf, 32))
+    for x in range(nxf):
+        for y in range(nyf):
+            row = y + x * nyf
+
+            def blocknorm(ox, oy):
+                base = (y + oy) * n_x + (x + ox)
+                return 1.0 / math.sqrt(
+                    norm[base] + norm[base + 1] + norm[base + n_x]
+                    + norm[base + n_x + 1] + 1e-4
+                )
+
+            n1, n2 = blocknorm(1, 1), blocknorm(0, 1)
+            n3, n4 = blocknorm(1, 0), blocknorm(0, 0)
+            t = [0.0] * 4
+            for o in range(18):
+                hv = hist[(y + 1) * n_x + (x + 1) + o * n_x * n_y]
+                hs = [min(hv * nn, 0.2) for nn in (n1, n2, n3, n4)]
+                feats[row, o] = 0.5 * sum(hs)
+                for i in range(4):
+                    t[i] += hs[i]
+            for o in range(9):
+                hv = (
+                    hist[(y + 1) * n_x + (x + 1) + o * n_x * n_y]
+                    + hist[(y + 1) * n_x + (x + 1) + (o + 9) * n_x * n_y]
+                )
+                feats[row, 18 + o] = 0.5 * sum(
+                    min(hv * nn, 0.2) for nn in (n1, n2, n3, n4)
+                )
+            for i in range(4):
+                feats[row, 27 + i] = 0.2357 * t[i]
+    np.testing.assert_allclose(out[0], feats, rtol=1e-3, atol=1e-3)
