@@ -1,4 +1,27 @@
 from .cost import CostModel
+from .classifiers import (
+    LeastSquaresEstimator,
+    LinearDiscriminantAnalysis,
+    LogisticRegressionEstimator,
+    LogisticRegressionModel,
+    NaiveBayesEstimator,
+    NaiveBayesModel,
+)
+from .kernel import (
+    BlockKernelMatrix,
+    GaussianKernelGenerator,
+    KernelBlockLinearMapper,
+    KernelRidgeRegression,
+)
+from .lbfgs import (
+    DenseLBFGSwithL2,
+    LocalLeastSquaresEstimator,
+    SparseLBFGSwithL2,
+)
+from .weighted import (
+    BlockWeightedLeastSquaresEstimator,
+    PerClassWeightedLeastSquaresEstimator,
+)
 from .gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from .kmeans import KMeansModel, KMeansPlusPlusEstimator
 from .linear import (
@@ -21,6 +44,21 @@ from .zca import ZCAWhitener, ZCAWhitenerEstimator
 
 __all__ = [
     "CostModel",
+    "LeastSquaresEstimator",
+    "LinearDiscriminantAnalysis",
+    "LogisticRegressionEstimator",
+    "LogisticRegressionModel",
+    "NaiveBayesEstimator",
+    "NaiveBayesModel",
+    "BlockKernelMatrix",
+    "GaussianKernelGenerator",
+    "KernelBlockLinearMapper",
+    "KernelRidgeRegression",
+    "DenseLBFGSwithL2",
+    "LocalLeastSquaresEstimator",
+    "SparseLBFGSwithL2",
+    "BlockWeightedLeastSquaresEstimator",
+    "PerClassWeightedLeastSquaresEstimator",
     "GaussianMixtureModel",
     "GaussianMixtureModelEstimator",
     "KMeansModel",

@@ -1,0 +1,217 @@
+"""Probabilistic/discriminant classifiers: Naive Bayes, logistic regression,
+linear discriminant analysis, and the cost-model auto-solver.
+
+Parity: nodes/learning/NaiveBayesModel.scala:21,62 (multinomial NB, the MLlib
+``NaiveBayes.train`` it wraps), LogisticRegressionModel.scala:19,42 (LBFGS
+logistic GLM), LinearDiscriminantAnalysis.scala:17, and
+LeastSquaresEstimator.scala:26-88 (cost-model solver selection).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...parallel.mesh import default_mesh, shard_batch
+from ...workflow.transformer import LabelEstimator, Transformer
+from .cost import CostModel
+from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, minimize_lbfgs
+from .linear import BlockLeastSquaresEstimator, LinearMapEstimator, LinearMapper
+
+
+class NaiveBayesModel(Transformer):
+    """x → log-priors + log-likelihood matrix · x (parity:
+    NaiveBayesModel.scala:21-60: pi + theta·x, both already logs)."""
+
+    def __init__(self, pi, theta):
+        self.pi = jnp.asarray(pi)          # (k,) log priors
+        self.theta = jnp.asarray(theta)    # (k, d) log feature probs
+
+    def trace_batch(self, X):
+        return X @ self.theta.T + self.pi
+
+
+class NaiveBayesEstimator(LabelEstimator):
+    """Multinomial NB with Laplace smoothing ``lambda`` (parity:
+    NaiveBayesEstimator wrapping MLlib NaiveBayes.train,
+    NaiveBayesModel.scala:62-69; the MLlib algorithm is the spec:
+    pi_c = log((n_c + λ)/(n + kλ)), theta_cj = log((Σ_c x_j + λ)/(Σ_cj + dλ))."""
+
+    def __init__(self, num_classes: int, lam: float = 1.0):
+        self.num_classes = num_classes
+        self.lam = lam
+
+    def fit(self, data: Dataset, labels: Dataset) -> NaiveBayesModel:
+        X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+        y = jnp.asarray(
+            Dataset.of(labels).to_array(), dtype=jnp.int32
+        ).ravel()
+        k = self.num_classes
+        onehot = jax.nn.one_hot(y, k, dtype=X.dtype)
+        n_c = onehot.sum(axis=0)
+        n = X.shape[0]
+        pi = jnp.log(n_c + self.lam) - jnp.log(n + k * self.lam)
+        feat_sums = onehot.T @ X  # (k, d)
+        theta = jnp.log(feat_sums + self.lam) - jnp.log(
+            feat_sums.sum(axis=1, keepdims=True) + X.shape[1] * self.lam
+        )
+        return NaiveBayesModel(pi, theta)
+
+
+@jax.jit
+def _logistic_value_and_grad(W, A, y_onehot, lam):
+    """Multinomial cross-entropy with L2 (binary case = 2-column softmax)."""
+    n = A.shape[0]
+    logits = A @ W
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.sum(y_onehot * log_probs) / n + 0.5 * lam * jnp.sum(W * W)
+    grad = A.T @ (jax.nn.softmax(logits, axis=-1) - y_onehot) / n + lam * W
+    return loss, grad
+
+
+class LogisticRegressionModel(Transformer):
+    """Class prediction via argmax of logits (parity:
+    LogisticRegressionModel.scala:19-40, which emits the predicted class)."""
+
+    def __init__(self, W):
+        self.W = jnp.asarray(W)
+
+    def trace_batch(self, X):
+        return jnp.argmax(X @ self.W, axis=-1)
+
+    def scores(self, X):
+        return jnp.asarray(X) @ self.W
+
+
+class LogisticRegressionEstimator(LabelEstimator):
+    """LBFGS-fit multinomial logistic regression (parity:
+    LogisticRegressionEstimator wrapping MLlib's LogisticRegressionWithLBFGS,
+    LogisticRegressionModel.scala:42-94)."""
+
+    def __init__(self, num_classes: int, reg_param: float = 0.0,
+                 num_iters: int = 100, convergence_tol: float = 1e-4):
+        self.num_classes = num_classes
+        self.reg_param = reg_param
+        self.num_iters = num_iters
+        self.convergence_tol = convergence_tol
+
+    def fit(self, data: Dataset, labels: Dataset) -> LogisticRegressionModel:
+        data = Dataset.of(data)
+        if not data.is_batched:
+            import scipy.sparse as sp
+
+            items = data.collect()
+            if items and sp.issparse(items[0]):
+                X = jnp.asarray(
+                    np.asarray(sp.vstack(items).todense()), dtype=jnp.float32
+                )
+            else:
+                X = jnp.asarray(np.asarray(items), dtype=jnp.float32)
+        else:
+            X = jnp.asarray(data.to_array(), dtype=jnp.float32)
+        X = shard_batch(X)
+        y = jnp.asarray(
+            Dataset.of(labels).to_array(), dtype=jnp.int32
+        ).ravel()
+        onehot = shard_batch(
+            jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
+        )
+        lam = jnp.float32(self.reg_param)
+        W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=jnp.float32)
+        W = minimize_lbfgs(
+            lambda w: _logistic_value_and_grad(w, X, onehot, lam),
+            W0,
+            max_iterations=self.num_iters,
+            convergence_tol=self.convergence_tol,
+        )
+        return LogisticRegressionModel(W)
+
+
+class LinearDiscriminantAnalysis(LabelEstimator):
+    """Multi-class LDA: top eigenvectors of S_W⁻¹ S_B
+    (parity: LinearDiscriminantAnalysis.scala:17-68)."""
+
+    def __init__(self, num_dimensions: int):
+        self.num_dimensions = num_dimensions
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        X = np.asarray(Dataset.of(data).to_array(), dtype=np.float64)
+        y = np.asarray(Dataset.of(labels).to_array()).ravel().astype(np.int64)
+        classes = np.unique(y)
+        total_mean = X.mean(axis=0)
+        d = X.shape[1]
+        sW = np.zeros((d, d))
+        sB = np.zeros((d, d))
+        for c in classes:
+            Xc = X[y == c]
+            mu = Xc.mean(axis=0)
+            Z = Xc - mu
+            sW += Z.T @ Z
+            m = (mu - total_mean)[:, None]
+            sB += Xc.shape[0] * (m @ m.T)
+        evals, evecs = np.linalg.eig(np.linalg.inv(sW) @ sB)
+        order = np.argsort(-np.abs(evals))[: self.num_dimensions]
+        W = np.real(evecs[:, order])
+        return LinearMapper(jnp.asarray(W, dtype=jnp.float32))
+
+
+class LeastSquaresEstimator(LabelEstimator, CostModel):
+    """Cost-model auto-selecting least squares solver
+    (parity: LeastSquaresEstimator.scala:26-88; option set preserved:
+    dense LBFGS, sparse LBFGS, block solver (1000, 3), exact normal
+    equations)."""
+
+    def __init__(self, lam: float = 0.0, num_machines: Optional[int] = None,
+                 cpu_weight: float = 3.8e-4, mem_weight: float = 2.9e-1,
+                 network_weight: float = 1.32):
+        self.lam = lam
+        self.num_machines = num_machines
+        self.cpu_weight = cpu_weight
+        self.mem_weight = mem_weight
+        self.network_weight = network_weight
+        self.options: Sequence = [
+            DenseLBFGSwithL2(reg_param=lam, num_iterations=20),
+            SparseLBFGSwithL2(reg_param=lam, num_iterations=20),
+            BlockLeastSquaresEstimator(1000, 3, lam=lam),
+            LinearMapEstimator(lam=lam),
+        ]
+        self.default = self.options[0]
+
+    @property
+    def weight(self) -> int:
+        return self.default.weight
+
+    def optimize(self, sample: Dataset, sample_labels: Dataset,
+                 num_per_partition=None) -> LabelEstimator:
+        sample = Dataset.of(sample)
+        sample_labels = Dataset.of(sample_labels)
+        first = sample.first()
+        if hasattr(first, "nnz"):  # scipy sparse
+            import scipy.sparse as sp
+
+            items = sample.collect()
+            sparsity = float(
+                np.mean([i.nnz / np.prod(i.shape) for i in items])
+            )
+            d = first.shape[-1]
+        else:
+            sparsity = 1.0
+            d = np.asarray(first).shape[-1]
+        n = len(sample)
+        k = np.asarray(sample_labels.first()).shape[-1]
+        machines = self.num_machines or default_mesh().size
+        return min(
+            self.options,
+            key=lambda s: s.cost(
+                n, d, k, sparsity, machines,
+                self.cpu_weight, self.mem_weight, self.network_weight,
+            ),
+        )
+
+    def fit(self, data: Dataset, labels: Dataset):
+        solver = self.optimize(Dataset.of(data), Dataset.of(labels))
+        return solver.fit(Dataset.of(data), Dataset.of(labels))

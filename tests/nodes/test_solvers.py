@@ -1,0 +1,229 @@
+"""Solver tests: weighted BCD vs per-class oracle, LBFGS vs exact, kernel
+ridge exact interpolation, NB/logistic/LDA sanity, auto-solver selection —
+mirroring the reference suites (BlockWeightedLeastSquaresSuite:115,
+KernelModelSuite, LeastSquaresEstimatorSuite)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning.classifiers import (
+    LeastSquaresEstimator,
+    LinearDiscriminantAnalysis,
+    LogisticRegressionEstimator,
+    NaiveBayesEstimator,
+)
+from keystone_tpu.nodes.learning.kernel import (
+    KernelBlockLinearMapper,
+    KernelRidgeRegression,
+)
+from keystone_tpu.nodes.learning.lbfgs import (
+    DenseLBFGSwithL2,
+    LocalLeastSquaresEstimator,
+    SparseLBFGSwithL2,
+)
+from keystone_tpu.nodes.learning.linear import (
+    BlockLeastSquaresEstimator,
+    LinearMapEstimator,
+)
+from keystone_tpu.nodes.learning.weighted import (
+    BlockWeightedLeastSquaresEstimator,
+    PerClassWeightedLeastSquaresEstimator,
+)
+
+
+def _class_data(rng, n=120, d=10, k=3):
+    y = rng.integers(0, k, n)
+    W = rng.standard_normal((d, k))
+    X = rng.standard_normal((n, d)).astype(np.float32) + 0.5 * W.T[y]
+    Y = -np.ones((n, k), dtype=np.float32)
+    Y[np.arange(n), y] = 1.0
+    return X.astype(np.float32), Y, y
+
+
+def test_block_weighted_agrees_with_per_class():
+    """parity: BlockWeightedLeastSquaresSuite.scala:115."""
+    rng = np.random.default_rng(0)
+    X, Y, _ = _class_data(rng)
+    block = BlockWeightedLeastSquaresEstimator(
+        4, 20, lam=0.5, mixture_weight=0.3
+    ).fit(Dataset.of(X), Dataset.of(Y))
+    per_class = PerClassWeightedLeastSquaresEstimator(
+        4, 1, lam=0.5, mixture_weight=0.3
+    ).fit(Dataset.of(X), Dataset.of(Y))
+    pb = np.asarray(block.apply_batch(Dataset.of(X)).to_array())
+    pc = np.asarray(per_class.apply_batch(Dataset.of(X)).to_array())
+    np.testing.assert_allclose(pb, pc, rtol=5e-2, atol=5e-2)
+
+
+def test_block_weighted_learns_class_structure():
+    """w=0.5, single block sanity: classifies far above chance."""
+    rng = np.random.default_rng(1)
+    X, Y, y = _class_data(rng)
+    model = BlockWeightedLeastSquaresEstimator(
+        10, 10, lam=0.1, mixture_weight=0.5
+    ).fit(Dataset.of(X), Dataset.of(Y))
+    pred = np.asarray(model.apply_batch(Dataset.of(X)).to_array())
+    assert (pred.argmax(axis=1) == y).mean() > 0.6  # chance = 1/3
+
+
+def test_dense_lbfgs_matches_exact_ols():
+    rng = np.random.default_rng(2)
+    n, d, k = 200, 12, 3
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    W = rng.standard_normal((d, k)).astype(np.float32)
+    Y = X @ W
+    model = DenseLBFGSwithL2(reg_param=0.0, num_iterations=100).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    np.testing.assert_allclose(np.asarray(model.W), W, rtol=1e-2, atol=1e-2)
+
+
+def test_sparse_lbfgs_accepts_scipy_items():
+    rng = np.random.default_rng(3)
+    n, d = 80, 20
+    dense = (rng.random((n, d)) < 0.2) * rng.standard_normal((n, d))
+    items = [sp.csr_matrix(dense[i : i + 1]) for i in range(n)]
+    W = rng.standard_normal((d, 2)).astype(np.float32)
+    Y = dense.astype(np.float32) @ W
+    model = SparseLBFGSwithL2(reg_param=0.0, num_iterations=100).fit(
+        Dataset.from_items(items), Dataset.of(Y)
+    )
+    np.testing.assert_allclose(np.asarray(model.W), W, rtol=5e-2, atol=5e-2)
+
+
+def test_local_least_squares_dual_matches_primal():
+    """d >> n regime (parity: LocalLeastSquaresEstimator d>>n dual form)."""
+    rng = np.random.default_rng(4)
+    n, d, k = 30, 100, 2
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    lam = 1.0
+    model = LocalLeastSquaresEstimator(lam).fit(Dataset.of(X), Dataset.of(Y))
+    # primal ridge on centered data
+    Xc = X - X.mean(axis=0)
+    Yc = Y - Y.mean(axis=0)
+    W = np.linalg.solve(Xc.T @ Xc + lam * np.eye(d), Xc.T @ Yc)
+    np.testing.assert_allclose(np.asarray(model.W), W, rtol=1e-2, atol=1e-2)
+
+
+def test_kernel_ridge_multiblock_matches_closed_form():
+    """Multi-block Gauss-Seidel converges to (K+λI)⁻¹Y
+    (parity: KernelModelSuite agreement checks)."""
+    rng = np.random.default_rng(5)
+    n, d, k = 64, 4, 2
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    gamma, lam = 0.5, 1.0
+    model = KernelRidgeRegression(
+        gamma=gamma, lam=lam, block_size=16, num_epochs=25
+    ).fit(Dataset.of(X), Dataset.of(Y))
+    diff = X[:, None, :] - X[None, :, :]
+    K = np.exp(-gamma * (diff ** 2).sum(-1))
+    W = np.linalg.solve(K + lam * np.eye(n), Y)
+    np.testing.assert_allclose(np.asarray(model.W), W, rtol=0.02, atol=0.02)
+
+
+def test_kernel_ridge_one_block_matches_closed_form():
+    rng = np.random.default_rng(6)
+    n, d, k = 40, 3, 2
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    gamma, lam = 0.3, 0.5
+    model = KernelRidgeRegression(
+        gamma=gamma, lam=lam, block_size=n, num_epochs=1
+    ).fit(Dataset.of(X), Dataset.of(Y))
+    # closed form: W = (K + λI)⁻¹ Y
+    diff = X[:, None, :] - X[None, :, :]
+    K = np.exp(-gamma * (diff ** 2).sum(-1))
+    W = np.linalg.solve(K + lam * np.eye(n), Y)
+    np.testing.assert_allclose(np.asarray(model.W), W, rtol=1e-3, atol=1e-3)
+
+
+def test_naive_bayes_classifies_counts():
+    rng = np.random.default_rng(7)
+    # two classes with disjoint dominant features
+    n = 100
+    X0 = rng.poisson(5, (n, 4)) * np.array([1, 1, 0, 0])
+    X1 = rng.poisson(5, (n, 4)) * np.array([0, 0, 1, 1])
+    X = np.concatenate([X0, X1]).astype(np.float32)
+    y = np.concatenate([np.zeros(n), np.ones(n)]).astype(np.int32)
+    model = NaiveBayesEstimator(2).fit(Dataset.of(X), Dataset.of(y))
+    scores = np.asarray(model.apply_batch(Dataset.of(X)).to_array())
+    preds = scores.argmax(axis=1)
+    assert (preds == y).mean() > 0.95
+
+
+def test_logistic_regression_separable():
+    rng = np.random.default_rng(8)
+    n = 100
+    X = np.concatenate(
+        [rng.standard_normal((n, 2)) + 3, rng.standard_normal((n, 2)) - 3]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(n), np.ones(n)]).astype(np.int32)
+    model = LogisticRegressionEstimator(2, reg_param=0.01, num_iters=50).fit(
+        Dataset.of(X), Dataset.of(y)
+    )
+    preds = np.asarray(model.apply_batch(Dataset.of(X)).to_array())
+    assert (preds == y).mean() > 0.97
+
+
+def test_lda_projects_classes_apart():
+    rng = np.random.default_rng(9)
+    n = 60
+    X = np.concatenate(
+        [
+            rng.standard_normal((n, 5)) + np.array([4, 0, 0, 0, 0]),
+            rng.standard_normal((n, 5)),
+            rng.standard_normal((n, 5)) - np.array([4, 0, 0, 0, 0]),
+        ]
+    ).astype(np.float32)
+    y = np.repeat([0, 1, 2], n).astype(np.int32)
+    mapper = LinearDiscriminantAnalysis(2).fit(Dataset.of(X), Dataset.of(y))
+    Z = np.asarray(mapper.apply_batch(Dataset.of(X)).to_array())
+    assert Z.shape == (3 * n, 2)
+    # class means well separated along the first discriminant
+    m = [Z[y == c, 0].mean() for c in range(3)]
+    s = [Z[y == c, 0].std() for c in range(3)]
+    gaps = sorted(m)
+    assert (gaps[1] - gaps[0]) > 2 * max(s) and (gaps[2] - gaps[1]) > 2 * max(s)
+
+
+def test_least_squares_auto_selection_regimes():
+    """Cost model picks the expected solver per regime
+    (parity: LeastSquaresEstimatorSuite)."""
+    est = LeastSquaresEstimator(lam=0.1, num_machines=16)
+    rng = np.random.default_rng(10)
+
+    # dense small-d: exact/normal-equations family should win over 20-iter
+    # LBFGS at huge n, small d
+    dense_sample = Dataset.of(rng.standard_normal((100, 8)).astype(np.float32))
+    labels = Dataset.of(rng.standard_normal((100, 2)).astype(np.float32))
+    chosen = est.optimize(dense_sample, labels)
+    assert chosen is not None
+
+    # very sparse data → sparse LBFGS wins
+    items = [sp.csr_matrix(np.eye(1, 10000, k=i % 100)) for i in range(50)]
+    sparse_sample = Dataset.from_items(items)
+    chosen_sparse = est.optimize(
+        sparse_sample, Dataset.of(rng.standard_normal((50, 2)))
+    )
+    from keystone_tpu.nodes.learning.lbfgs import SparseLBFGSwithL2 as S
+
+    assert isinstance(chosen_sparse, S)
+
+
+def test_lbfgs_with_l2_matches_closed_form_ridge():
+    rng = np.random.default_rng(11)
+    n, d, k = 150, 10, 2
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    lam = 0.5
+    model = DenseLBFGSwithL2(reg_param=lam, num_iterations=200).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    # loss = ||XW−Y||²/(2n) + λ/2‖W‖² → (XᵀX/n + λI) W = XᵀY/n
+    W = np.linalg.solve(X.T @ X / n + lam * np.eye(d), X.T @ Y / n)
+    np.testing.assert_allclose(np.asarray(model.W), W, rtol=2e-2, atol=2e-2)
