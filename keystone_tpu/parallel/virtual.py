@@ -1,0 +1,59 @@
+"""Virtual-device provisioning: an N-device CPU platform standing in for a
+TPU slice, the way Spark ``local[n]`` stands in for a cluster in the
+reference's tests (src/test/scala/keystoneml/workflow/PipelineContext.scala:9-25).
+
+Used by tests/conftest.py (fixed 8-device mesh for the suite) and by
+``__graft_entry__.dryrun_multichip`` (driver-chosen device count).
+"""
+
+from __future__ import annotations
+
+import os
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def provision_virtual_devices(n_devices: int) -> None:
+    """Force an ``n_devices``-device virtual CPU platform, process-wide.
+
+    Importing this module already pulls in jax (via the package __init__),
+    so this always works through the live config: tear down any initialized
+    backend (e.g. the driver's single real TPU chip), then point the config
+    at an N-device CPU platform. The env vars are also set so child
+    processes inherit the same view. The switch is one-way: after this
+    call, everything in the process runs on virtual CPU devices — callers
+    that still need the real accelerator must use a separate process.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split() if _COUNT_FLAG not in f)
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --{_COUNT_FLAG}={n_devices}"
+    ).strip()
+
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:
+        initialized = True
+    if initialized:
+        # Drop the live backend so the next jax.devices() re-reads the
+        # config. Must happen before the config updates below
+        # (num_cpu_devices rejects changes post-init). The public API also
+        # flushes the get_backend memo and jit caches.
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:
+        pass  # older jax: the XLA_FLAGS path above still applies
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"could not provision {n_devices} virtual CPU devices "
+            f"(have {len(jax.devices())})"
+        )

@@ -2,27 +2,13 @@
 same way Spark local[n] does in the reference's PipelineContext
 (src/test/scala/keystoneml/workflow/PipelineContext.scala:9-25)."""
 
-import os
+# Must happen before any test imports jax-using code. Force CPU even when
+# the outer environment points at a real accelerator (JAX_PLATFORMS=axon):
+# tests need the 8-device virtual mesh, and the single real chip can't
+# provide it. Handles sitecustomize pre-importing jax.
+from keystone_tpu.parallel.virtual import provision_virtual_devices
 
-# Must happen before jax is imported anywhere. Force CPU even when the outer
-# environment points at a real accelerator (JAX_PLATFORMS=axon): tests need
-# the 8-device virtual mesh, and the single real chip can't provide it.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-# Force exactly 8 virtual devices (tests assert on the mesh size); strip any
-# pre-existing count the outer environment may have set.
-flags = " ".join(
-    f for f in flags.split() if "xla_force_host_platform_device_count" not in f
-)
-os.environ["XLA_FLAGS"] = (
-    flags + " --xla_force_host_platform_device_count=8"
-).strip()
-
-import jax  # noqa: E402
-
-# sitecustomize pre-imports jax before this conftest runs, so the env var
-# alone is too late — update the live config as well.
-jax.config.update("jax_platforms", "cpu")
+provision_virtual_devices(8)
 
 import pytest  # noqa: E402
 
