@@ -1,44 +1,221 @@
-"""Benchmark driver — BASELINE metric #1: MnistRandomFFT end-to-end train time.
+"""Benchmark driver. Prints ONE JSON line whose headline is BASELINE metric
+#1 (MnistRandomFFT end-to-end train time) with a phase breakdown, a
+flops-derived utilization estimate for the solve, and BASELINE metric #2
+(ImageNet SIFT+LCS Fisher-Vector featurize+predict images/sec) under
+``extra``.
 
-Runs the canonical config (numFFTs=4, blockSize=2048 — reference
-examples/images/mnist_random_fft.sh:8-9) at full MNIST scale (60k train /
-10k test, 784 pixels) on whatever jax platform is active (the real TPU chip
-under the driver; CPU elsewhere) and prints ONE JSON line.
+Baseline provenance (stated, not laundered): the reference publishes NO
+number for either metric (BASELINE.json "published": {}). The MNIST
+comparison point of 180 s is an extrapolation from the reference's own
+solver-comparison table — a d=1024 exact solve on 16× r3.4xlarge took
+186.1 s (reference scripts/solver-comparisons-final.csv:2) and the MNIST
+config (d=2048-block solve + 4 FFT featurizations over 60k rows) is the
+same order of work on that cluster. vs_baseline = 180 / our_seconds
+(>1 ⇒ faster than the reference cluster). The ImageNet images/sec metric
+has no reference number at all; it is recorded for round-over-round
+tracking (vs_baseline omitted from extra, headline vs_baseline refers to
+MNIST only).
 
-vs_baseline: the reference publishes no number for this metric
-(BASELINE.json "published": {}); its MnistRandomFFT logs wall-clock at
-runtime. The recorded comparison point is 180 s — the reference's own
-solver-comparison table puts a d=1024 exact solve on 16 machines at 186.1 s
-(scripts/solver-comparisons-final.csv:2) and the MNIST config (d=2048 block
-solve + 4 FFT featurizations over 60k rows) is the same order of work, run
-here on Spark-equivalent cluster hardware. vs_baseline = baseline_s /
-our_s (>1 ⇒ faster than the reference cluster).
+Data: real MNIST CSVs are used when present (same format as the reference's
+train-mnist-dense-with-labels.data: label in column 0, 1-indexed); otherwise
+class-structured synthetic data of the same shape. The JSON records which.
 """
 
 import json
+import os
 import time
 
-BASELINE_SECONDS = 180.0
+MNIST_BASELINE_SECONDS = 180.0
+MNIST_DATA_CANDIDATES = [
+    "data/train-mnist-dense-with-labels.data",
+    "data/mnist/train-mnist-dense-with-labels.data",
+]
 
 
-def main() -> int:
+def _device_peak_flops() -> float:
+    """Peak f32 FLOP/s of the active device, for the utilization estimate.
+
+    TPU v5e: ~197 Tf/s bf16 ⇒ ~98.5 Tf/s f32 (MXU). CPU fallback uses a
+    nominal 100 Gf/s so the ratio stays meaningful in local runs.
+    """
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "tpu":
+        return 98.5e12
+    return 100e9
+
+
+def bench_mnist() -> dict:
+    from keystone_tpu.evaluation.multiclass import MulticlassClassifierEvaluator
+    from keystone_tpu.loaders.csv_loader import load_labeled_csv
+    from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
     from keystone_tpu.pipelines.mnist_random_fft import (
         MnistRandomFFTConfig,
-        run,
+        NUM_CLASSES,
+        build_featurizer,
         synthetic_mnist,
     )
 
-    train, test = synthetic_mnist(n_train=60000, n_test=10000, seed=42)
+    import jax
+
+    data_source = "synthetic"
+    train = test = None
+    for cand in MNIST_DATA_CANDIDATES:
+        if os.path.exists(cand):
+            train = load_labeled_csv(cand, label_offset=1)
+            test_cand = cand.replace("train-", "test-")
+            if os.path.exists(test_cand):
+                test = load_labeled_csv(test_cand, label_offset=1)
+                data_source = cand
+            else:
+                # no held-out file: the "test" numbers would be train-set
+                # numbers — record that explicitly rather than hide it
+                test = train
+                data_source = f"{cand} (no test file; test==train)"
+            break
+    if train is None:
+        train, test = synthetic_mnist(n_train=60000, n_test=10000, seed=42)
+
     conf = MnistRandomFFTConfig(num_ffts=4, block_size=2048, lam=1e3)
+
     t0 = time.perf_counter()
-    _, train_err, test_err, seconds = run(train, test, conf)
+    labels = ClassLabelIndicators(NUM_CLASSES).apply_batch(train.labels)
+    pipeline = (
+        build_featurizer(conf)
+        .and_then(
+            BlockLeastSquaresEstimator(conf.block_size, 1, conf.lam),
+            train.data,
+            labels,
+        )
+        .and_then(MaxClassifier())
+    )
+    # fit = featurize 60k rows + block solve (the training phase)
+    fitted = pipeline.fit()
+    t_fit = time.perf_counter() - t0
+
+    # compile the estimator-free chain into one XLA program (warmup at the
+    # full test shape — jit is shape-specialized, so a smaller warmup batch
+    # would push a recompile into the timed apply)
+    t1 = time.perf_counter()
+    fitted.compile()
+    test_X = test.data.to_array()
+    _ = jax.block_until_ready(fitted.apply_compiled(test_X))
+    t_compile = time.perf_counter() - t1
+
+    # steady-state apply on the full test set
+    t2 = time.perf_counter()
+    test_pred = jax.block_until_ready(fitted.apply_compiled(test_X))
+    t_apply = time.perf_counter() - t2
+
+    test_err = (
+        MulticlassClassifierEvaluator(NUM_CLASSES)
+        .evaluate(test_pred, test.labels)
+        .total_error
+    )
+    total = time.perf_counter() - t0
+
+    # Solve utilization: the block solve is Gram (n·d·b per block ⇒ n·d²
+    # total over column blocks) + Cholesky (d³/3). d measured from the
+    # actual featurizer output (4 branches × 512 real rfft bins = 2048).
+    n = len(train.data.to_array())
+    d = int(
+        build_featurizer(conf)(test_X[:2]).get().to_array().shape[-1]
+    )
+    solve_flops = 2.0 * n * d * d + (d**3) / 3.0
+    mfu_solve = solve_flops / max(t_fit, 1e-9) / _device_peak_flops()
+
+    return {
+        "seconds": round(total, 3),
+        "phases": {
+            "fit": round(t_fit, 3),
+            "compile": round(t_compile, 3),
+            "apply_10k": round(t_apply, 3),
+        },
+        "test_err_pct": round(100 * test_err, 2),
+        "data": data_source,
+        "solve_flops": solve_flops,
+        "mfu_solve_lower_bound": round(mfu_solve, 4),
+    }
+
+
+def bench_imagenet_fv() -> dict:
+    """BASELINE metric #2: featurize+predict throughput of the fitted
+    SIFT+LCS Fisher-Vector pipeline at the reference feature config
+    (descDim=64, vocabSize=16 — ImageNetSiftLcsFV.scala:146-167), measured
+    steady-state after compile on a canonical 96×96 batch."""
+    import jax
+    import numpy as np
+
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        build_predictor,
+        synthetic_imagenet,
+    )
+
+    num_classes = 64
+    conf = ImageNetSiftLcsFVConfig(
+        desc_dim=64,
+        vocab_size=16,
+        num_pca_samples=200_000,
+        num_gmm_samples=200_000,
+        num_classes=num_classes,
+        lam=1e-4,
+    )
+    tr_i, tr_l = synthetic_imagenet(128, num_classes, size=96, seed=1)
+
+    t0 = time.perf_counter()
+    predictor = build_predictor(tr_i, tr_l, conf)
+    fitted = predictor.fit()
+    t_fit = time.perf_counter() - t0
+
+    batch = synthetic_imagenet(64, num_classes, size=96, seed=9)[0]
+    t1 = time.perf_counter()
+    _ = jax.block_until_ready(np.asarray(fitted.apply(batch).to_array()))
+    t_compile = time.perf_counter() - t1
+
+    # steady state: apply the fitted two-branch featurizer + model
+    reps = 3
+    t2 = time.perf_counter()
+    for _ in range(reps):
+        _ = jax.block_until_ready(np.asarray(fitted.apply(batch).to_array()))
+    t_apply = (time.perf_counter() - t2) / reps
+    ips = len(batch) / t_apply
+
+    return {
+        "images_per_sec": round(ips, 2),
+        "phases": {
+            "fit_128imgs": round(t_fit, 3),
+            "first_apply": round(t_compile, 3),
+            "steady_apply_64imgs": round(t_apply, 3),
+        },
+        "config": "descDim=64 vocabSize=16 96x96 synthetic",
+    }
+
+
+def main() -> int:
+    mnist = bench_mnist()
+    imagenet = bench_imagenet_fv()
     print(
         json.dumps(
             {
                 "metric": "mnist_random_fft_e2e_train",
-                "value": round(seconds, 3),
+                "value": mnist["seconds"],
                 "unit": "seconds",
-                "vs_baseline": round(BASELINE_SECONDS / seconds, 2),
+                "vs_baseline": round(
+                    MNIST_BASELINE_SECONDS / mnist["seconds"], 2
+                ),
+                "baseline_provenance": (
+                    "180s extrapolated from reference "
+                    "scripts/solver-comparisons-final.csv:2 (d=1024 exact "
+                    "solve, 16x r3.4xlarge, 186.1s); reference publishes no "
+                    "number for this metric"
+                ),
+                "extra": {
+                    "mnist": mnist,
+                    "imagenet_sift_lcs_fv": imagenet,
+                },
             }
         )
     )

@@ -1,0 +1,79 @@
+"""ImageNetSiftLcsFV end-to-end on synthetic textured images
+(parity slice: ImageNetSiftLcsFV.scala:19-204, BASELINE metric #2)."""
+
+import numpy as np
+
+from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+    ImageNetSiftLcsFVConfig,
+    run,
+    synthetic_imagenet,
+    top_k_err_percent,
+)
+
+
+def test_top_k_err_percent_oracle():
+    topk = np.array([[0, 1, 2], [3, 4, 5], [6, 7, 8]])
+    actual = np.array([1, 9, 8])  # hit, miss, hit
+    assert abs(top_k_err_percent(topk, actual) - 100.0 / 3.0) < 1e-9
+
+
+def test_imagenet_sift_lcs_fv_end_to_end():
+    num_classes = 16
+    tr_i, tr_l = synthetic_imagenet(96, num_classes, size=48, seed=1)
+    te_i, te_l = synthetic_imagenet(48, num_classes, size=48, seed=2)
+    conf = ImageNetSiftLcsFVConfig(
+        desc_dim=16,
+        vocab_size=4,
+        num_pca_samples=20_000,
+        num_gmm_samples=20_000,
+        num_classes=num_classes,
+        lam=1e-4,
+    )
+    predictor, err, _ = run(tr_i, tr_l, te_i, te_l, conf)
+    # top-5 of 16 classes: random scoring errs ~68.75%; the gratings are
+    # separable so the gathered SIFT+LCS FV features must do far better.
+    assert err < 25.0, f"top-5 error {err}%"
+    # predictions are a (n, 5) int index matrix
+    out = np.asarray(predictor(te_i).get().to_array())
+    assert out.shape == (48, 5)
+
+
+def test_imagenet_pca_gmm_checkpoint_load(tmp_path):
+    """Both branches loadable from CSV checkpoints
+    (parity: ImageNetSiftLcsFV.scala:40-66)."""
+    rng = np.random.default_rng(0)
+    dims, k = 8, 4
+    num_classes = 8
+    paths = {}
+    # LCS feature rows with the default patch=6: 3 channels × 4×4
+    # neighborhood offsets × (mean, std) = 96.
+    for branch, d_in in (("sift", 128), ("lcs", 96)):
+        pca = rng.standard_normal((dims, d_in)).astype(np.float32)
+        means = rng.standard_normal((dims, k))
+        variances = rng.uniform(0.5, 1.5, (dims, k))
+        weights = np.full(k, 1.0 / k)
+        for name, arr in (
+            ("pca", pca), ("m", means), ("v", variances), ("w", weights)
+        ):
+            f = tmp_path / f"{branch}_{name}.csv"
+            np.savetxt(f, arr, delimiter=",")
+            paths[f"{branch}_{name}"] = str(f)
+
+    tr_i, tr_l = synthetic_imagenet(24, num_classes, size=48, seed=3)
+    te_i, te_l = synthetic_imagenet(12, num_classes, size=48, seed=4)
+    conf = ImageNetSiftLcsFVConfig(
+        desc_dim=dims,
+        vocab_size=k,
+        num_classes=num_classes,
+        lam=1e-2,
+        sift_pca_file=paths["sift_pca"],
+        sift_gmm_mean_file=paths["sift_m"],
+        sift_gmm_var_file=paths["sift_v"],
+        sift_gmm_wts_file=paths["sift_w"],
+        lcs_pca_file=paths["lcs_pca"],
+        lcs_gmm_mean_file=paths["lcs_m"],
+        lcs_gmm_var_file=paths["lcs_v"],
+        lcs_gmm_wts_file=paths["lcs_w"],
+    )
+    _, err, _ = run(tr_i, tr_l, te_i, te_l, conf)
+    assert np.isfinite(err)
