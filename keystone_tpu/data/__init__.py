@@ -1,3 +1,4 @@
 from .dataset import Dataset
+from .sparse import SparseRows
 
-__all__ = ["Dataset"]
+__all__ = ["Dataset", "SparseRows"]

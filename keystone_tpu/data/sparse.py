@@ -1,0 +1,155 @@
+"""Padded sparse-row batches — the TPU answer to the reference's
+``SparseVector[Double]`` rows (breeze sparse vectors inside RDDs).
+
+XLA has no dynamic sparsity, so sparse feature rows are stored as a padded
+COO batch: ``indices (n, m) int32`` + ``values (n, m) float32`` with a
+static row capacity ``m`` (max nnz, rounded up). Padding entries carry
+``value == 0`` at index 0, which is algebraically inert for every consumer:
+
+  * ``matmul(W)``   — embedding-style gather ``W[indices]·values`` (the MXU
+                      path for SparseLinearMapper / sparse LBFGS gradients);
+                      zero values contribute nothing.
+  * ``to_dense()``  — scatter-add; zero values contribute nothing.
+  * class sums      — scatter-add into (classes, d); same argument.
+
+This is the SURVEY §7 "sparse text features" decision point: top-K feature
+selection (CommonSparseFeatures) keeps K bounded, rows keep a small static
+capacity, and everything downstream is gathers/scatters XLA tiles well.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _round_up(x: int, multiple: int = 8) -> int:
+    return max(multiple, ((x + multiple - 1) // multiple) * multiple)
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseRows:
+    """A batch of n sparse feature rows over a d-dim feature space."""
+
+    def __init__(self, indices, values, num_features: int):
+        self.indices = indices  # (n, m) int32, padded with 0
+        self.values = values    # (n, m) float32, padded with 0.0
+        self.num_features = int(num_features)
+
+    # -- pytree protocol -------------------------------------------------
+
+    def tree_flatten(self):
+        return (self.indices, self.values), self.num_features
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    # -- shape -----------------------------------------------------------
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (int(self.indices.shape[0]), self.num_features)
+
+    @property
+    def row_capacity(self) -> int:
+        return int(self.indices.shape[-1])
+
+    @property
+    def nnz(self) -> int:
+        return int(np.sum(np.asarray(self.values) != 0))
+
+    def __len__(self) -> int:
+        return int(self.indices.shape[0])
+
+    # -- constructors ----------------------------------------------------
+
+    @staticmethod
+    def from_pairs(
+        rows: Iterable[Sequence[Tuple[int, float]]],
+        num_features: int,
+        row_capacity: int = None,
+    ) -> "SparseRows":
+        """Build from per-row (feature_index, value) pair lists. Rows longer
+        than the capacity keep their largest-|value| entries."""
+        rows = [list(r) for r in rows]
+        max_nnz = max((len(r) for r in rows), default=1)
+        m = row_capacity or _round_up(max_nnz)
+        n = len(rows)
+        idx = np.zeros((n, m), dtype=np.int32)
+        val = np.zeros((n, m), dtype=np.float32)
+        for i, r in enumerate(rows):
+            if len(r) > m:
+                r = sorted(r, key=lambda p: -abs(p[1]))[:m]
+            for j, (f, v) in enumerate(r):
+                idx[i, j] = f
+                val[i, j] = v
+        return SparseRows(jnp.asarray(idx), jnp.asarray(val), num_features)
+
+    @staticmethod
+    def from_scipy(mat) -> "SparseRows":
+        import scipy.sparse as sp
+
+        csr = sp.csr_matrix(mat)
+        rows = [
+            list(zip(csr.indices[s:e], csr.data[s:e]))
+            for s, e in zip(csr.indptr[:-1], csr.indptr[1:])
+        ]
+        return SparseRows.from_pairs(rows, csr.shape[1])
+
+    # -- consumers -------------------------------------------------------
+
+    def to_dense(self) -> jnp.ndarray:
+        """(n, d) dense scatter. Prefer matmul() when d is large."""
+        n, m = self.indices.shape
+        out = jnp.zeros((n, self.num_features), dtype=self.values.dtype)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
+        return out.at[rows, self.indices].add(self.values)
+
+    def matmul(self, W) -> jnp.ndarray:
+        """X @ W without densifying: gather W rows by feature index, weight
+        by values, reduce over the row capacity. W: (d, k) → (n, k)."""
+        W = jnp.asarray(W)
+        gathered = W[self.indices]  # (n, m, k)
+        return jnp.einsum("nmk,nm->nk", gathered, self.values)
+
+    def rmatmul(self, R) -> jnp.ndarray:
+        """Xᵀ @ R without densifying: scatter-add row contributions into a
+        (d, k) accumulator. R: (n, k) → (d, k). This is the gradient-side
+        primitive (Aᵀ·residual) of the sparse solvers."""
+        R = jnp.asarray(R)
+        k = R.shape[1]
+        contrib = self.values[:, :, None] * R[:, None, :]  # (n, m, k)
+        out = jnp.zeros((self.num_features, k), dtype=self.values.dtype)
+        idx = jnp.broadcast_to(self.indices[:, :, None], contrib.shape)
+        col = jnp.broadcast_to(jnp.arange(k)[None, None, :], contrib.shape)
+        return out.at[idx, col].add(contrib)
+
+    def class_sums(self, onehot) -> jnp.ndarray:
+        """onehotᵀ @ X without densifying: scatter-add values into a
+        (classes, d) accumulator. onehot: (n, k) → (k, d)."""
+        onehot = jnp.asarray(onehot)
+        k = onehot.shape[1]
+        # (n, m, k) contributions scattered by feature index
+        contrib = self.values[:, :, None] * onehot[:, None, :]  # (n, m, k)
+        out = jnp.zeros((k, self.num_features), dtype=self.values.dtype)
+        idx = jnp.broadcast_to(
+            self.indices[:, :, None], contrib.shape
+        )
+        cls = jnp.broadcast_to(
+            jnp.arange(k)[None, None, :], contrib.shape
+        )
+        return out.at[cls, idx].add(contrib)
+
+    def density(self) -> float:
+        n, d = self.shape
+        return self.nnz / float(max(n * d, 1))
+
+    def __getitem__(self, i) -> "SparseRows":
+        sl = self.indices[i], self.values[i]
+        if np.ndim(sl[0]) == 1:  # single row → keep 2-D batch form
+            sl = (sl[0][None], sl[1][None])
+        return SparseRows(sl[0], sl[1], self.num_features)

@@ -1,5 +1,15 @@
+from .augmented import AugmentedExamplesEvaluator
 from .base import Evaluator
+from .binary import BinaryClassificationMetrics, BinaryClassifierEvaluator
 from .mean_average_precision import MeanAveragePrecisionEvaluator
 from .multiclass import MulticlassClassifierEvaluator, MulticlassMetrics
 
-__all__ = ["Evaluator", "MeanAveragePrecisionEvaluator", "MulticlassClassifierEvaluator", "MulticlassMetrics"]
+__all__ = [
+    "AugmentedExamplesEvaluator",
+    "BinaryClassificationMetrics",
+    "BinaryClassifierEvaluator",
+    "Evaluator",
+    "MeanAveragePrecisionEvaluator",
+    "MulticlassClassifierEvaluator",
+    "MulticlassMetrics",
+]

@@ -1,0 +1,81 @@
+"""Binary classifier evaluation.
+
+Parity: evaluation/BinaryClassifierEvaluator.scala:17-82
+(BinaryClassificationMetrics contingency table + one-pass evaluator). The
+reference's per-item map + merge-reduce collapses into four vectorized
+counts over the prediction arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .base import Evaluator, resolve
+
+
+@dataclass
+class BinaryClassificationMetrics:
+    """(parity: BinaryClassificationMetrics case class)."""
+
+    tp: float
+    fp: float
+    tn: float
+    fn: float
+
+    def merge(self, other: "BinaryClassificationMetrics"):
+        return BinaryClassificationMetrics(
+            self.tp + other.tp, self.fp + other.fp,
+            self.tn + other.tn, self.fn + other.fn,
+        )
+
+    @property
+    def accuracy(self) -> float:
+        return (self.tp + self.tn) / (self.tp + self.fp + self.tn + self.fn)
+
+    @property
+    def error(self) -> float:
+        return (self.fp + self.fn) / (self.tp + self.fp + self.tn + self.fn)
+
+    @property
+    def recall(self) -> float:
+        return self.tp / (self.tp + self.fn) if (self.tp + self.fn) else 0.0
+
+    @property
+    def precision(self) -> float:
+        return self.tp / (self.tp + self.fp) if (self.tp + self.fp) else 0.0
+
+    @property
+    def specificity(self) -> float:
+        return self.tn / (self.fp + self.tn) if (self.fp + self.tn) else 0.0
+
+    def f_score(self, beta: float = 1.0) -> float:
+        num = (1.0 + beta * beta) * self.tp
+        denom = (1.0 + beta * beta) * self.tp + beta * beta * self.fn + self.fp
+        return num / denom if denom else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"Accuracy:\t{self.accuracy:2.3f}\n"
+            f"Precision:\t{self.precision:2.3f}\n"
+            f"Recall:\t{self.recall:2.3f}\n"
+            f"Specificity:\t{self.specificity:2.3f}\n"
+            f"F1:\t{self.f_score():2.3f}"
+        )
+
+
+class BinaryClassifierEvaluator(Evaluator):
+    """One-pass contingency table from boolean predictions/actuals."""
+
+    def evaluate(self, predictions, actuals) -> BinaryClassificationMetrics:
+        pred = np.asarray(resolve(predictions)).astype(bool).ravel()
+        act = np.asarray(resolve(actuals)).astype(bool).ravel()
+        if pred.shape != act.shape:
+            raise ValueError("predictions and actuals must align")
+        return BinaryClassificationMetrics(
+            tp=float(np.sum(pred & act)),
+            fp=float(np.sum(pred & ~act)),
+            tn=float(np.sum(~pred & ~act)),
+            fn=float(np.sum(~pred & act)),
+        )

@@ -124,14 +124,27 @@ class MulticlassMetrics:
     def micro_f_score(self, beta: float = 1.0) -> float:
         return self._micro(lambda m: m.f_score(beta))
 
-    def summary(self) -> str:
-        return (
-            f"total accuracy: {self.total_accuracy:.3f}\n"
-            f"total error: {self.total_error:.3f}\n"
-            f"macro precision: {self.macro_precision:.3f}\n"
-            f"macro recall: {self.macro_recall:.3f}\n"
-            f"macro f1: {self.macro_f_score():.3f}"
-        )
+    def summary(self, class_names=None) -> str:
+        """Aggregate metrics; with ``class_names``, adds the per-class
+        accuracy table (parity: MulticlassMetrics.summary(classLabels),
+        MulticlassClassifierEvaluator.scala:130)."""
+        lines = [
+            f"total accuracy: {self.total_accuracy:.3f}",
+            f"total error: {self.total_error:.3f}",
+            f"macro precision: {self.macro_precision:.3f}",
+            f"macro recall: {self.macro_recall:.3f}",
+            f"macro f1: {self.macro_f_score():.3f}",
+        ]
+        if class_names is not None:
+            for i, name in enumerate(class_names):
+                if i >= len(self.class_metrics):
+                    break
+                m = self.class_metrics[i]
+                lines.append(
+                    f"  {name}: accuracy {m.accuracy:.3f} "
+                    f"precision {m.precision:.3f} recall {m.recall:.3f}"
+                )
+        return "\n".join(lines)
 
 
 @jax.jit

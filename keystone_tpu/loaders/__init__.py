@@ -1,3 +1,19 @@
 from .csv_loader import LabeledData, load_csv, load_labeled_csv
+from .text import (
+    NEWSGROUPS_CLASSES,
+    TimitFeaturesData,
+    load_amazon_reviews,
+    load_newsgroups,
+    load_timit_features,
+)
 
-__all__ = ["LabeledData", "load_csv", "load_labeled_csv"]
+__all__ = [
+    "LabeledData",
+    "load_csv",
+    "load_labeled_csv",
+    "NEWSGROUPS_CLASSES",
+    "TimitFeaturesData",
+    "load_amazon_reviews",
+    "load_newsgroups",
+    "load_timit_features",
+]

@@ -1,0 +1,134 @@
+"""Text and speech-feature loaders.
+
+Parity: loaders/NewsgroupsDataLoader.scala:9-52 (class-per-directory
+plaintext docs), loaders/AmazonReviewsDataLoader.scala:7-29 (JSON reviews →
+binary labels by rating threshold), loaders/TimitFeaturesDataLoader.scala:15-75
+(pre-featurized CSV + "row label" sparse label files).
+
+All host-side filesystem work — the reference used Spark's wholeTextFiles /
+Spark SQL JSON; here plain directory walks and json-lines parsing feed the
+same LabeledData shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from .csv_loader import LabeledData, load_csv
+
+# The 20 Newsgroups class labels / directory names
+# (NewsgroupsDataLoader.scala:11-32)
+NEWSGROUPS_CLASSES = (
+    "comp.graphics",
+    "comp.os.ms-windows.misc",
+    "comp.sys.ibm.pc.hardware",
+    "comp.sys.mac.hardware",
+    "comp.windows.x",
+    "rec.autos",
+    "rec.motorcycles",
+    "rec.sport.baseball",
+    "rec.sport.hockey",
+    "sci.crypt",
+    "sci.electronics",
+    "sci.med",
+    "sci.space",
+    "misc.forsale",
+    "talk.politics.misc",
+    "talk.politics.guns",
+    "talk.politics.mideast",
+    "talk.religion.misc",
+    "alt.atheism",
+    "soc.religion.christian",
+)
+
+
+def load_newsgroups(data_dir: str) -> LabeledData:
+    """``data_dir/<class_name>/<doc files>`` → (int labels, doc strings)
+    (parity: NewsgroupsDataLoader.apply). Classes absent on disk are
+    skipped, matching wholeTextFiles over missing dirs yielding nothing."""
+    labels, docs = [], []
+    for index, class_name in enumerate(NEWSGROUPS_CLASSES):
+        class_dir = os.path.join(data_dir, class_name)
+        if not os.path.isdir(class_dir):
+            continue
+        for fname in sorted(os.listdir(class_dir)):
+            fpath = os.path.join(class_dir, fname)
+            if not os.path.isfile(fpath):
+                continue
+            with open(fpath, "r", encoding="utf-8", errors="replace") as f:
+                docs.append(f.read())
+            labels.append(index)
+    return LabeledData(
+        np.asarray(labels, dtype=np.int32), Dataset.from_items(docs)
+    )
+
+
+def load_amazon_reviews(path: str, threshold: float = 3.5) -> LabeledData:
+    """JSON-lines reviews with "overall" rating and "reviewText" →
+    binary labels (rating ≥ threshold ⇒ 1)
+    (parity: AmazonReviewsDataLoader.apply)."""
+    labels, docs = [], []
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            labels.append(1 if float(rec["overall"]) >= threshold else 0)
+            docs.append(rec.get("reviewText", ""))
+    return LabeledData(
+        np.asarray(labels, dtype=np.int32), Dataset.from_items(docs)
+    )
+
+
+TIMIT_DIMENSION = 440  # TimitFeaturesDataLoader.timitDimension
+TIMIT_NUM_CLASSES = 147  # TimitFeaturesDataLoader.numClasses
+
+
+class TimitFeaturesData:
+    """(parity: TimitFeaturesData case class)."""
+
+    def __init__(self, train: LabeledData, test: LabeledData):
+        self.train = train
+        self.test = test
+
+
+def _parse_sparse_labels(path: str) -> dict:
+    """Lines "row label" (1-indexed rows)
+    (parity: parseSparseLabels, TimitFeaturesDataLoader.scala:22-33)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                out[int(parts[0]) - 1] = int(parts[1])
+    return out
+
+
+def load_timit_features(
+    train_data: str,
+    train_labels: str,
+    test_data: str,
+    test_labels: Optional[str] = None,
+) -> TimitFeaturesData:
+    """Pre-featurized TIMIT CSVs + sparse label files; labels are shifted
+    to 0-indexed classes (parity: TimitFeaturesDataLoader.apply — the
+    ``labelsMap(row) - 1``)."""
+
+    def one(data_path, labels_path):
+        X = np.asarray(load_csv(data_path).payload)
+        lmap = _parse_sparse_labels(labels_path)
+        y = np.asarray(
+            [lmap[i] - 1 for i in range(X.shape[0])], dtype=np.int32
+        )
+        return LabeledData(y, X)
+
+    return TimitFeaturesData(
+        one(train_data, train_labels),
+        one(test_data, test_labels or train_labels),
+    )

@@ -29,6 +29,7 @@ from .linear import (
     BlockLinearMapper,
     LinearMapEstimator,
     LinearMapper,
+    SparseLinearMapper,
 )
 from .pca import (
     ApproximatePCAEstimator,
@@ -67,6 +68,7 @@ __all__ = [
     "BlockLinearMapper",
     "LinearMapEstimator",
     "LinearMapper",
+    "SparseLinearMapper",
     "ApproximatePCAEstimator",
     "BatchPCATransformer",
     "ColumnPCAEstimator",

@@ -34,6 +34,16 @@ class NaiveBayesModel(Transformer):
     def trace_batch(self, X):
         return X @ self.theta.T + self.pi
 
+    def apply_batch(self, data):
+        from ...data.sparse import SparseRows
+
+        data = Dataset.of(data)
+        if isinstance(data.payload, SparseRows):
+            return Dataset(
+                data.payload.matmul(self.theta.T) + self.pi, batched=True
+            )
+        return super().apply_batch(data)
+
 
 class NaiveBayesEstimator(LabelEstimator):
     """Multinomial NB with Laplace smoothing ``lambda`` (parity:
@@ -46,18 +56,26 @@ class NaiveBayesEstimator(LabelEstimator):
         self.lam = lam
 
     def fit(self, data: Dataset, labels: Dataset) -> NaiveBayesModel:
-        X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+        from ...data.sparse import SparseRows
+
+        data = Dataset.of(data)
         y = jnp.asarray(
             Dataset.of(labels).to_array(), dtype=jnp.int32
         ).ravel()
         k = self.num_classes
-        onehot = jax.nn.one_hot(y, k, dtype=X.dtype)
+        onehot = jax.nn.one_hot(y, k, dtype=jnp.float32)
+        if isinstance(data.payload, SparseRows):
+            X = data.payload
+            n, d = X.shape
+            feat_sums = X.class_sums(onehot)  # (k, d) scatter-add on device
+        else:
+            X = jnp.asarray(data.to_array(), dtype=jnp.float32)
+            n, d = X.shape
+            feat_sums = onehot.T @ X  # (k, d)
         n_c = onehot.sum(axis=0)
-        n = X.shape[0]
         pi = jnp.log(n_c + self.lam) - jnp.log(n + k * self.lam)
-        feat_sums = onehot.T @ X  # (k, d)
         theta = jnp.log(feat_sums + self.lam) - jnp.log(
-            feat_sums.sum(axis=1, keepdims=True) + X.shape[1] * self.lam
+            feat_sums.sum(axis=1, keepdims=True) + d * self.lam
         )
         return NaiveBayesModel(pi, theta)
 
@@ -73,6 +91,18 @@ def _logistic_value_and_grad(W, A, y_onehot, lam):
     return loss, grad
 
 
+def _sparse_logistic_value_and_grad(W, X, y_onehot, lam):
+    """Sparse-input multinomial cross-entropy: gather-matmul forward,
+    scatter-add gradient (no densification)."""
+    n = y_onehot.shape[0]
+    logits = X.matmul(W)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.sum(y_onehot * log_probs) / n + 0.5 * lam * jnp.sum(W * W)
+    resid = jax.nn.softmax(logits, axis=-1) - y_onehot
+    grad = X.rmatmul(resid) / n + lam * W
+    return loss, grad
+
+
 class LogisticRegressionModel(Transformer):
     """Class prediction via argmax of logits (parity:
     LogisticRegressionModel.scala:19-40, which emits the predicted class)."""
@@ -82,6 +112,17 @@ class LogisticRegressionModel(Transformer):
 
     def trace_batch(self, X):
         return jnp.argmax(X @ self.W, axis=-1)
+
+    def apply_batch(self, data):
+        from ...data.sparse import SparseRows
+
+        data = Dataset.of(data)
+        if isinstance(data.payload, SparseRows):
+            return Dataset(
+                jnp.argmax(data.payload.matmul(self.W), axis=-1),
+                batched=True,
+            )
+        return super().apply_batch(data)
 
     def scores(self, X):
         return jnp.asarray(X) @ self.W
@@ -100,30 +141,42 @@ class LogisticRegressionEstimator(LabelEstimator):
         self.convergence_tol = convergence_tol
 
     def fit(self, data: Dataset, labels: Dataset) -> LogisticRegressionModel:
-        data = Dataset.of(data)
-        if not data.is_batched:
-            import scipy.sparse as sp
+        from ...data.sparse import SparseRows
 
-            items = data.collect()
-            if items and sp.issparse(items[0]):
-                X = jnp.asarray(
-                    np.asarray(sp.vstack(items).todense()), dtype=jnp.float32
-                )
-            else:
-                X = jnp.asarray(np.asarray(items), dtype=jnp.float32)
-        else:
-            X = jnp.asarray(data.to_array(), dtype=jnp.float32)
-        X = shard_batch(X)
+        data = Dataset.of(data)
         y = jnp.asarray(
             Dataset.of(labels).to_array(), dtype=jnp.int32
         ).ravel()
-        onehot = shard_batch(
-            jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
-        )
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
         lam = jnp.float32(self.reg_param)
-        W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=jnp.float32)
+        if isinstance(data.payload, SparseRows):
+            X = data.payload
+            W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=jnp.float32)
+            vag = jax.jit(
+                lambda w: _sparse_logistic_value_and_grad(w, X, onehot, lam)
+            )
+        else:
+            if not data.is_batched:
+                import scipy.sparse as sp
+
+                items = data.collect()
+                if items and sp.issparse(items[0]):
+                    X = jnp.asarray(
+                        np.asarray(sp.vstack(items).todense()),
+                        dtype=jnp.float32,
+                    )
+                else:
+                    X = jnp.asarray(np.asarray(items), dtype=jnp.float32)
+            else:
+                X = jnp.asarray(data.to_array(), dtype=jnp.float32)
+            X = shard_batch(X)
+            onehot_dev = shard_batch(onehot)
+            W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=jnp.float32)
+            vag = lambda w: _logistic_value_and_grad(  # noqa: E731
+                w, X, onehot_dev, lam
+            )
         W = minimize_lbfgs(
-            lambda w: _logistic_value_and_grad(w, X, onehot, lam),
+            vag,
             W0,
             max_iterations=self.num_iters,
             convergence_tol=self.convergence_tol,
@@ -187,20 +240,24 @@ class LeastSquaresEstimator(LabelEstimator, CostModel):
 
     def optimize(self, sample: Dataset, sample_labels: Dataset,
                  num_per_partition=None) -> LabelEstimator:
+        from ...data.sparse import SparseRows
+
         sample = Dataset.of(sample)
         sample_labels = Dataset.of(sample_labels)
-        first = sample.first()
-        if hasattr(first, "nnz"):  # scipy sparse
-            import scipy.sparse as sp
-
-            items = sample.collect()
-            sparsity = float(
-                np.mean([i.nnz / np.prod(i.shape) for i in items])
-            )
-            d = first.shape[-1]
+        if isinstance(sample.payload, SparseRows):
+            sparsity = sample.payload.density()
+            d = sample.payload.num_features
         else:
-            sparsity = 1.0
-            d = np.asarray(first).shape[-1]
+            first = sample.first()
+            if hasattr(first, "nnz"):  # scipy sparse
+                items = sample.collect()
+                sparsity = float(
+                    np.mean([i.nnz / np.prod(i.shape) for i in items])
+                )
+                d = first.shape[-1]
+            else:
+                sparsity = 1.0
+                d = np.asarray(first).shape[-1]
         n = len(sample)
         k = np.asarray(sample_labels.first()).shape[-1]
         machines = self.num_machines or default_mesh().size

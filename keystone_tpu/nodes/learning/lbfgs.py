@@ -150,26 +150,55 @@ class DenseLBFGSwithL2(LabelEstimator, CostModel):
 class SparseLBFGSwithL2(DenseLBFGSwithL2):
     """Sparse-input variant (parity: SparseLBFGSwithL2, LBFGS.scala:208).
 
-    XLA has no dynamic sparsity: scipy.sparse inputs are densified on device
-    (fine at the reference's 100k-feature scale — SURVEY §7 hard parts); the
-    cost model keeps the reference's sparsity-scaled form so the auto-solver
-    selection logic is preserved.
+    XLA has no dynamic sparsity, so sparse rows arrive as a padded-COO
+    ``SparseRows`` batch and the least-squares gradient runs as
+    gather-matmul (A·W) + scatter-add (Aᵀ·residual) — never densified
+    (the SURVEY §7 decision). scipy.sparse inputs are converted to
+    SparseRows first. Returns a SparseLinearMapper so the fitted model also
+    applies sparsely.
     """
 
     sparse_overhead = 10.0
 
-    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+    def fit(self, data: Dataset, labels: Dataset):
+        from ...data.sparse import SparseRows
+        from .linear import SparseLinearMapper
+
         data = Dataset.of(data)
-        if not data.is_batched:
+        X = None
+        if isinstance(data.payload, SparseRows):
+            X = data.payload
+        elif not data.is_batched:
             import scipy.sparse as sp
 
             items = data.collect()
             if items and sp.issparse(items[0]):
-                dense = np.asarray(sp.vstack(items).todense())
+                X = SparseRows.from_scipy(sp.vstack(items))
             else:
-                dense = np.asarray(items)
-            data = Dataset.of(dense.astype(np.float32))
-        return super().fit(data, labels)
+                return super().fit(Dataset.of(np.asarray(items)), labels)
+        if X is None:
+            return super().fit(data, labels)
+
+        B = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        lam = jnp.float32(self.reg_param)
+        n = B.shape[0]
+
+        @jax.jit
+        def vag(W):
+            axb = X.matmul(W) - B
+            loss = 0.5 * jnp.sum(axb * axb) / n + 0.5 * lam * jnp.sum(W * W)
+            grad = X.rmatmul(axb) / n + lam * W
+            return loss, grad
+
+        W0 = jnp.zeros((X.shape[1], B.shape[1]), dtype=jnp.float32)
+        W = minimize_lbfgs(
+            vag,
+            W0,
+            max_iterations=self.num_iterations,
+            num_corrections=self.num_corrections,
+            convergence_tol=self.convergence_tol,
+        )
+        return SparseLinearMapper(W)
 
     def cost(self, n, d, k, sparsity, num_machines,
              cpu_weight, mem_weight, network_weight):

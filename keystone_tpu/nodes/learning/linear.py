@@ -177,3 +177,49 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
             max(cpu_weight * flops, mem_weight * bytes_scanned)
             + network_weight * network
         )
+
+
+class SparseLinearMapper(Transformer):
+    """Apply a dense trained model to sparse input rows: xᵀ·W (+ b)
+    (parity: SparseLinearMapper.scala:13-50).
+
+    TPU path: ``SparseRows`` batches apply as an embedding-style gather
+    (W[indices]·values, data/sparse.py) — no densification at any width.
+    """
+
+    def __init__(self, W, b=None):
+        self.W = jnp.asarray(W)
+        self.b = None if b is None else jnp.asarray(b)
+
+    def apply_batch(self, data):
+        from ...data.sparse import SparseRows
+
+        data = Dataset.of(data)
+        if isinstance(data.payload, SparseRows):
+            out = data.payload.matmul(self.W)
+            if self.b is not None:
+                out = out + self.b
+            return Dataset(out, batched=True)
+        return data.map_batch(self.trace_batch)
+
+    def trace_batch(self, X):
+        out = jnp.asarray(X) @ self.W
+        if self.b is not None:
+            out = out + self.b
+        return out
+
+    def apply(self, x):
+        from ...data.sparse import SparseRows
+
+        if isinstance(x, SparseRows):
+            out = x.matmul(self.W)
+            out = out if self.b is None else out + self.b
+            return out[0] if len(x) == 1 else out
+        if hasattr(x, "nnz"):  # scipy sparse vector/matrix
+            import numpy as np
+
+            dense = jnp.asarray(np.asarray(x.todense()))
+            if dense.ndim == 2 and dense.shape[0] > 1:
+                return self.trace_batch(dense)  # r×d matrix → r×k batch
+            x = dense.reshape(-1)
+        return self.trace_batch(jnp.asarray(x)[None])[0]

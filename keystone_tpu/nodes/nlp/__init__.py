@@ -1,0 +1,43 @@
+"""Text/NLP nodes (parity: nodes/nlp/ — StringUtils, ngrams, HashingTF,
+indexers, StupidBackoff, WordFrequencyEncoder)."""
+
+from .hashing import (
+    HashingTF,
+    NGramsHashingTF,
+    java_string_hash,
+    murmur3_seq_hash,
+    scala_hash,
+)
+from .indexers import NaiveBitPackIndexer, NGramIndexerImpl
+from .ngrams import (
+    NGramsCounts,
+    NGramsFeaturizer,
+    WordFrequencyEncoder,
+    WordFrequencyTransformer,
+)
+from .stupid_backoff import (
+    StupidBackoffEstimator,
+    StupidBackoffModel,
+    score_stupid_backoff,
+)
+from .text import LowerCase, Tokenizer, Trim
+
+__all__ = [
+    "HashingTF",
+    "NGramsHashingTF",
+    "java_string_hash",
+    "murmur3_seq_hash",
+    "scala_hash",
+    "NaiveBitPackIndexer",
+    "NGramIndexerImpl",
+    "NGramsCounts",
+    "NGramsFeaturizer",
+    "WordFrequencyEncoder",
+    "WordFrequencyTransformer",
+    "StupidBackoffEstimator",
+    "StupidBackoffModel",
+    "score_stupid_backoff",
+    "LowerCase",
+    "Tokenizer",
+    "Trim",
+]

@@ -1,0 +1,170 @@
+"""Feature hashing with exact reference-hash parity.
+
+Parity: nodes/nlp/HashingTF.scala:15-32 and NGramsHashingTF.scala:25-146.
+The reference hashes terms with Scala's ``.##`` (Java hashCode for strings,
+MurmurHash3 seq-hash for Seq[String] n-grams) and asserts the rolling
+NGramsHashingTF "should return the exact same feature vector" as
+NGramsFeaturizer→HashingTF. We reproduce those hash functions bit-for-bit
+(32-bit two's complement), which makes that invariant a cross-implementation
+test oracle here too — and means feature indices match the reference's,
+so models are checkpoint-compatible at the feature level.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ...data.sparse import SparseRows
+from ...data.dataset import Dataset
+from ...workflow.transformer import Transformer
+
+_M32 = 0xFFFFFFFF
+
+
+def _signed32(x: int) -> int:
+    x &= _M32
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def _rotl32(x: int, n: int) -> int:
+    x &= _M32
+    return ((x << n) | (x >> (32 - n))) & _M32
+
+
+def java_string_hash(s: str) -> int:
+    """java.lang.String.hashCode (what Scala's ``"x".##`` returns)."""
+    h = 0
+    for c in s:
+        h = (31 * h + ord(c)) & _M32
+    return _signed32(h)
+
+
+def _mix_last(hash_: int, data: int) -> int:
+    k = (data & _M32) * 0xCC9E2D51 & _M32
+    k = _rotl32(k, 15)
+    k = k * 0x1B873593 & _M32
+    return (hash_ ^ k) & _M32
+
+
+def _mix(hash_: int, data: int) -> int:
+    h = _mix_last(hash_, data)
+    h = _rotl32(h, 13)
+    return (h * 5 + 0xE6546B64) & _M32
+
+
+def _avalanche(h: int) -> int:
+    h &= _M32
+    h ^= h >> 16
+    h = h * 0x85EBCA6B & _M32
+    h ^= h >> 13
+    h = h * 0xC2B2AE35 & _M32
+    h ^= h >> 16
+    return h
+
+
+def _finalize(hash_: int, length: int) -> int:
+    return _signed32(_avalanche(hash_ ^ length))
+
+
+SEQ_SEED = java_string_hash("Seq")  # scala.util.hashing.MurmurHash3.seqSeed
+
+
+def murmur3_seq_hash(element_hashes: Sequence[int]) -> int:
+    """scala MurmurHash3.seqHash over pre-hashed elements (the Seq.## of an
+    n-gram of strings)."""
+    h = SEQ_SEED & _M32
+    for eh in element_hashes:
+        h = _mix(h, eh)
+    return _finalize(h, len(element_hashes))
+
+
+def scala_hash(term) -> int:
+    """Scala ``.##`` for the term types the reference hashes: strings,
+    ints, and seqs of either (n-grams)."""
+    if isinstance(term, str):
+        return java_string_hash(term)
+    if isinstance(term, bool):
+        return 1231 if term else 1237
+    if isinstance(term, int):
+        return _signed32(term)  # Int.## == value (within 32 bits)
+    if isinstance(term, (tuple, list)):
+        return murmur3_seq_hash([scala_hash(t) for t in term])
+    return _signed32(hash(term))
+
+
+def _non_negative_mod(x: int, mod: int) -> int:
+    r = int(_signed32(x)) % mod
+    # Python % is already non-negative for positive mod; the reference's
+    # branch is for Java semantics. Kept for clarity.
+    return r + mod if r < 0 else r
+
+
+class HashingTF(Transformer):
+    """Term sequence → sparse term-frequency row by the hashing trick
+    (parity: HashingTF.scala:15-32)."""
+
+    def __init__(self, num_features: int):
+        self.num_features = num_features
+
+    def apply(self, document) -> List[Tuple[int, float]]:
+        tf = {}
+        for term in document:
+            i = _non_negative_mod(scala_hash(term), self.num_features)
+            tf[i] = tf.get(i, 0.0) + 1.0
+        return sorted(tf.items())
+
+    def apply_batch(self, data) -> Dataset:
+        data = Dataset.of(data)
+        rows = [self.apply(doc) for doc in data]
+        return Dataset(
+            SparseRows.from_pairs(rows, self.num_features), batched=True
+        )
+
+
+class NGramsHashingTF(Transformer):
+    """Rolling-hash n-gram HashingTF: identical output to
+    NGramsFeaturizer(orders)→HashingTF, without constructing the n-grams
+    (parity: NGramsHashingTF.scala:25-146)."""
+
+    def __init__(self, orders: Sequence[int], num_features: int):
+        orders = list(orders)
+        if min(orders) < 1:
+            raise ValueError(f"minimum order is not >= 1, found {min(orders)}")
+        for a, b in zip(orders, orders[1:]):
+            if b != a + 1:
+                raise ValueError(
+                    f"orders are not consecutive; contains {a} and {b}"
+                )
+        self.orders = orders
+        self.min_order = orders[0]
+        self.max_order = orders[-1]
+        self.num_features = num_features
+
+    def apply(self, line: Sequence[str]) -> List[Tuple[int, float]]:
+        hashes = [java_string_hash(w) for w in line]
+        n = len(hashes)
+        tf = {}
+        for i in range(n - self.min_order + 1):
+            h = SEQ_SEED & _M32
+            for j in range(i, i + self.min_order):
+                h = _mix(h, hashes[j])
+            feat = _non_negative_mod(
+                _finalize(h, self.min_order), self.num_features
+            )
+            tf[feat] = tf.get(feat, 0.0) + 1.0
+            order = self.min_order + 1
+            while order <= self.max_order and i + order <= n:
+                h = _mix(h, hashes[i + order - 1])
+                feat = _non_negative_mod(
+                    _finalize(h, order), self.num_features
+                )
+                tf[feat] = tf.get(feat, 0.0) + 1.0
+                order += 1
+        return sorted(tf.items())
+
+    def apply_batch(self, data) -> Dataset:
+        data = Dataset.of(data)
+        rows = [self.apply(doc) for doc in data]
+        return Dataset(
+            SparseRows.from_pairs(rows, self.num_features), batched=True
+        )

@@ -1,0 +1,143 @@
+"""Packed n-gram indexers.
+
+Parity: nodes/nlp/indexers.scala:49-140 (NaiveBitPackIndexer /
+NGramIndexerImpl over the BackoffIndexer trait). The bit-packed form is the
+TPU-relevant one: a trigram becomes one int64, so corpora of n-grams are
+dense integer arrays that sort/unique/gather on device. All pack/unpack
+ops here are exposed both per-ngram (parity API) and vectorized over numpy
+int64 arrays (the batch path language models use).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+_WORD_BITS = 20
+_WORD_MASK = (1 << _WORD_BITS) - 1
+
+
+class NaiveBitPackIndexer:
+    """Packs up to 3 word ids (each < 2^20) into one int64
+    (parity: NaiveBitPackIndexer, indexers.scala:49-115).
+
+    Layout (msb→lsb): [4 control bits][farthest word][middle][current],
+    left-aligned; control bits 00=unigram, 01=bigram, 10=trigram.
+    """
+
+    min_ngram_order = 1
+    max_ngram_order = 3
+
+    @staticmethod
+    def pack(ngram: Sequence[int]) -> int:
+        for w in ngram:
+            if w >= (1 << _WORD_BITS):
+                raise ValueError(f"word id {w} >= 2^20")
+        n = len(ngram)
+        if n == 1:
+            return ngram[0] << 40
+        if n == 2:
+            return (ngram[1] << 20) | (ngram[0] << 40) | (1 << 60)
+        if n == 3:
+            return ngram[2] | (ngram[1] << 20) | (ngram[0] << 40) | (1 << 61)
+        raise ValueError("ngram order need to be in { 1, 2, 3 } for now")
+
+    @staticmethod
+    def unpack(ngram: int, pos: int) -> int:
+        if pos == 0:
+            return (ngram >> 40) & _WORD_MASK
+        if pos == 1:
+            return (ngram >> 20) & _WORD_MASK
+        if pos == 2:
+            return ngram & _WORD_MASK
+        raise ValueError("ngram order need to be in { 1, 2, 3 } for now")
+
+    @classmethod
+    def ngram_order(cls, ngram: int) -> int:
+        order = (ngram >> 60) & 0xF
+        if not (cls.min_ngram_order <= order + 1 <= cls.max_ngram_order):
+            raise ValueError(f"raw control bits {order} are invalid")
+        return order + 1
+
+    @classmethod
+    def remove_farthest_word(cls, ngram: int) -> int:
+        order = cls.ngram_order(ngram)
+        stripped = ngram & ((1 << 40) - 1)
+        shifted = stripped << 20
+        if order == 2:
+            return shifted  # becomes a unigram (control 00)
+        if order == 3:
+            return shifted | (1 << 60)  # becomes a bigram
+        raise ValueError(f"ngram order is either invalid or not supported: {order}")
+
+    @classmethod
+    def remove_current_word(cls, ngram: int) -> int:
+        order = cls.ngram_order(ngram)
+        if order == 2:
+            return ngram & ~((1 << 40) - 1) & ~(0xF << 60)
+        if order == 3:
+            return (ngram & ~(_WORD_MASK) & ~(0xF << 60)) | (1 << 60)
+        raise ValueError(f"ngram order is either invalid or not supported: {order}")
+
+    # -- vectorized batch forms (the TPU-side layout) --------------------
+
+    @staticmethod
+    def pack_batch(words: np.ndarray, order: int) -> np.ndarray:
+        """(n, order) int word-id matrix → (n,) packed int64 array."""
+        words = np.asarray(words, dtype=np.int64)
+        if order == 1:
+            return words[:, 0] << 40
+        if order == 2:
+            return (words[:, 1] << 20) | (words[:, 0] << 40) | (1 << 60)
+        if order == 3:
+            return (
+                words[:, 2]
+                | (words[:, 1] << 20)
+                | (words[:, 0] << 40)
+                | (1 << 61)
+            )
+        raise ValueError("order must be in {1, 2, 3}")
+
+    @staticmethod
+    def unpack_batch(packed: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(n,) packed → ((n, 3) word ids, (n,) orders)."""
+        packed = np.asarray(packed, dtype=np.int64)
+        orders = ((packed >> 60) & 0xF) + 1
+        words = np.stack(
+            [
+                (packed >> 40) & _WORD_MASK,
+                (packed >> 20) & _WORD_MASK,
+                packed & _WORD_MASK,
+            ],
+            axis=1,
+        )
+        return words, orders
+
+
+class NGramIndexerImpl:
+    """Tuple-based indexer for arbitrary orders
+    (parity: NGramIndexerImpl, indexers.scala:117-140)."""
+
+    min_ngram_order = 1
+    max_ngram_order = 5
+
+    @staticmethod
+    def pack(ngram: Sequence) -> tuple:
+        return tuple(ngram)
+
+    @staticmethod
+    def unpack(ngram: tuple, pos: int):
+        return ngram[pos]
+
+    @staticmethod
+    def remove_farthest_word(ngram: tuple) -> tuple:
+        return tuple(ngram[1:])
+
+    @staticmethod
+    def remove_current_word(ngram: tuple) -> tuple:
+        return tuple(ngram[:-1])
+
+    @staticmethod
+    def ngram_order(ngram: tuple) -> int:
+        return len(ngram)

@@ -1,0 +1,110 @@
+"""N-gram featurization, counting, and frequency encoding.
+
+Parity: nodes/nlp/ngrams.scala:20-180 (NGramsFeaturizer / NGram /
+NGramsCounts) and nodes/nlp/WordFrequencyEncoder.scala:7-66. N-grams are
+plain Python tuples (hashable, ordered — the role of the reference's NGram
+wrapper class, ngrams.scala:100-140). Counting and vocabulary building are
+host-side corpus reductions (the reference's reduceByKey/sortBy shuffles,
+ngrams.scala:175-180); the device boundary comes at vectorization.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+from ...data.dataset import Dataset
+from ...workflow.transformer import Estimator, Transformer
+
+
+class NGramsFeaturizer(Transformer):
+    """Token sequence → all n-grams for consecutive ``orders``
+    (parity: NGramsFeaturizer, ngrams.scala:20-97)."""
+
+    def __init__(self, orders: Sequence[int]):
+        orders = list(orders)
+        if min(orders) < 1:
+            raise ValueError(f"minimum order is not >= 1, found {min(orders)}")
+        for a, b in zip(orders, orders[1:]):
+            if b != a + 1:
+                raise ValueError(
+                    f"orders are not consecutive; contains {a} and {b}"
+                )
+        self.orders = orders
+        self.min_order = orders[0]
+        self.max_order = orders[-1]
+
+    def apply(self, tokens: Sequence) -> List[tuple]:
+        tokens = list(tokens)
+        out: List[tuple] = []
+        n = len(tokens)
+        for i in range(n - self.min_order + 1):
+            for order in range(self.min_order, self.max_order + 1):
+                if i + order > n:
+                    break
+                out.append(tuple(tokens[i : i + order]))
+        return out
+
+
+class NGramsCounts(Transformer):
+    """Corpus-level n-gram occurrence counts, sorted by descending frequency
+    (parity: NGramsCounts, ngrams.scala:152-180). A dataset-level reduction:
+    input is a dataset of per-document n-gram lists, output a dataset of
+    (ngram, count) pairs. mode='noadd' skips the sort (the reference's
+    NoAdd skips cross-partition aggregation)."""
+
+    def __init__(self, mode: str = "default"):
+        if mode not in ("default", "noadd"):
+            raise ValueError("`mode` must be `default` or `noAdd`")
+        self.mode = mode
+
+    def apply(self, ngram_list: Sequence[tuple]) -> List[Tuple[tuple, int]]:
+        counts = Counter(tuple(g) for g in ngram_list)
+        return list(counts.items())
+
+    def apply_batch(self, data) -> Dataset:
+        data = Dataset.of(data)
+        counts: Counter = Counter()
+        for doc in data:
+            counts.update(tuple(g) for g in doc)
+        items = list(counts.items())
+        if self.mode == "default":
+            items.sort(key=lambda kv: -kv[1])
+        return Dataset.from_items(items)
+
+
+class WordFrequencyTransformer(Transformer):
+    """Token → frequency-rank index; out-of-vocabulary → -1
+    (parity: WordFrequencyTransformer, WordFrequencyEncoder.scala:43-66)."""
+
+    OOV_INDEX = -1
+
+    def __init__(self, word_index: Dict[str, int],
+                 unigram_counts: Dict[int, int]):
+        self.word_index = dict(word_index)
+        self.unigram_counts = dict(unigram_counts)
+
+    def apply(self, words: Sequence[str]) -> List[int]:
+        idx = self.word_index
+        return [idx.get(w, self.OOV_INDEX) for w in words]
+
+
+class WordFrequencyEncoder(Estimator):
+    """Build the sorted-by-frequency vocabulary encoding
+    (parity: WordFrequencyEncoder, WordFrequencyEncoder.scala:7-31)."""
+
+    def fit(self, data: Dataset) -> WordFrequencyTransformer:
+        data = Dataset.of(data)
+        unigrams = (
+            NGramsCounts().apply_batch(
+                Dataset.from_items(
+                    [NGramsFeaturizer([1]).apply(doc) for doc in data]
+                )
+            )
+        ).collect()
+        # indexes respect the sorted (desc-frequency) order
+        word_index = {gram[0]: i for i, (gram, _) in enumerate(unigrams)}
+        unigram_counts = {
+            word_index[gram[0]]: cnt for gram, cnt in unigrams
+        }
+        return WordFrequencyTransformer(word_index, unigram_counts)

@@ -1,0 +1,122 @@
+"""Stupid Backoff n-gram language model (Brants et al., 2007).
+
+Parity: nodes/nlp/StupidBackoff.scala:25-200. Scores are relative
+frequencies with a recursive α-discounted backoff:
+
+    S(w_i | context) = freq(ngram)/freq(context)        if freq(ngram) > 0
+                     = α · S(w_i | shorter context)      otherwise
+    S(w_i)           = freq(w_i) / numTokens
+
+The reference keeps counts in an RDD partitioned by initial bigram
+(InitialBigramPartitioner) and scores per-partition; here the count table is
+one host dict (the single-process reduction of that shuffle) and
+``score_batch`` vectorizes scoring over an array of n-grams via the same
+recursion. The n-gram keys are tuples (NGramIndexerImpl packing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from ...data.dataset import Dataset
+from ...workflow.transformer import Estimator, Transformer
+from .indexers import NGramIndexerImpl
+
+
+def score_stupid_backoff(
+    ngram: tuple,
+    ngram_counts: Dict[tuple, int],
+    unigram_counts: Dict, num_tokens: int,
+    alpha: float = 0.4,
+    accum: float = 1.0,
+) -> float:
+    """(parity: StupidBackoff.scoreLocally, StupidBackoff.scala:63-95)."""
+    indexer = NGramIndexerImpl
+    freq = ngram_counts.get(ngram, 0)
+    # Unigram queries: the fitted table usually holds orders >= 2 (the
+    # pipeline counts 2..n-grams), so fall back to the unigram table.
+    if freq == 0 and indexer.ngram_order(ngram) == 1:
+        freq = unigram_counts.get(ngram[0], 0)
+    while True:
+        order = indexer.ngram_order(ngram)
+        if order == 1:
+            return accum * freq / num_tokens
+        if freq != 0:
+            context = indexer.remove_current_word(ngram)
+            if order != 2:
+                context_freq = ngram_counts.get(context, 0)
+            else:
+                context_freq = unigram_counts.get(context[0], 0)
+            return accum * freq / context_freq
+        # out-of-corpus ngram: back off
+        ngram = indexer.remove_farthest_word(ngram)
+        order = indexer.ngram_order(ngram)
+        if order != 1:
+            freq = ngram_counts.get(ngram, 0)
+        else:
+            freq = unigram_counts.get(ngram[0], 0)
+        accum *= alpha
+
+
+class StupidBackoffModel(Transformer):
+    """Query with ``score(ngram)`` / ``score_batch(ngrams)``
+    (parity: StupidBackoffModel, StupidBackoff.scala:100-135; like the
+    reference, it is not meant to be chained)."""
+
+    def __init__(self, scores: Dict[tuple, float],
+                 ngram_counts: Dict[tuple, int],
+                 unigram_counts: Dict, num_tokens: int,
+                 alpha: float = 0.4):
+        self.scores = scores
+        self.ngram_counts = ngram_counts
+        self.unigram_counts = unigram_counts
+        self.num_tokens = num_tokens
+        self.alpha = alpha
+
+    def score(self, ngram: Sequence) -> float:
+        return score_stupid_backoff(
+            tuple(ngram), self.ngram_counts, self.unigram_counts,
+            self.num_tokens, self.alpha,
+        )
+
+    def score_batch(self, ngrams: Sequence[Sequence]) -> List[float]:
+        return [self.score(g) for g in ngrams]
+
+    def apply(self, x):
+        raise TypeError(
+            "Doesn't make sense to chain this node; use score(ngram)."
+        )
+
+
+class StupidBackoffEstimator(Estimator):
+    """Fit the score table from corpus (ngram, count) pairs
+    (parity: StupidBackoffEstimator, StupidBackoff.scala:155-200).
+
+    ``unigram_counts`` maps word → count (the pre-computed unigrams the
+    reference also takes as a constructor argument)."""
+
+    def __init__(self, unigram_counts: Dict, alpha: float = 0.4):
+        self.unigram_counts = dict(unigram_counts)
+        self.alpha = alpha
+
+    def fit(self, data: Dataset) -> StupidBackoffModel:
+        data = Dataset.of(data)
+        ngram_counts: Dict[tuple, int] = {}
+        for ngram, count in data:
+            key = tuple(ngram)
+            ngram_counts[key] = ngram_counts.get(key, 0) + int(count)
+        num_tokens = sum(self.unigram_counts.values())
+        scores = {}
+        for ngram, freq in ngram_counts.items():
+            s = score_stupid_backoff(
+                ngram, ngram_counts, self.unigram_counts,
+                num_tokens, self.alpha,
+            )
+            if not (0.0 <= s <= 1.0):
+                raise AssertionError(
+                    f"score = {s:.4f} not in [0,1], ngram = {ngram}"
+                )
+            scores[ngram] = s
+        return StupidBackoffModel(
+            scores, ngram_counts, self.unigram_counts, num_tokens, self.alpha
+        )

@@ -1,0 +1,52 @@
+"""String preprocessing transformers.
+
+Parity: nodes/nlp/StringUtils.scala:13-33 (Tokenizer / Trim / LowerCase).
+Host-side by nature (strings are not device data); each is a per-item
+Transformer whose batch form maps over the item list. The device boundary
+comes later in text pipelines, at the sparse-vectorization step.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ...workflow.transformer import Transformer
+
+
+class Tokenizer(Transformer):
+    """Split on a delimiting regex; default matches the reference's
+    punctuation+whitespace class (StringUtils.scala:13-15). Java's split
+    drops trailing empties but keeps a leading empty token when the string
+    starts with a separator — reproduced here for oracle parity."""
+
+    def __init__(self, sep: str = r"[^\w]+"):
+        self.sep = sep
+        self._re = re.compile(sep)
+
+    def apply(self, x: str):
+        parts = self._re.split(x)
+        # Java String.split: trailing empty strings removed, leading kept
+        while parts and parts[-1] == "":
+            parts.pop()
+        return parts
+
+    def __getstate__(self):
+        return {"sep": self.sep}
+
+    def __setstate__(self, state):
+        self.sep = state["sep"]
+        self._re = re.compile(self.sep)
+
+
+class Trim(Transformer):
+    """Strip leading/trailing whitespace (StringUtils.scala:20)."""
+
+    def apply(self, x: str) -> str:
+        return x.strip()
+
+
+class LowerCase(Transformer):
+    """Lower-case (StringUtils.scala:28)."""
+
+    def apply(self, x: str) -> str:
+        return x.lower()

@@ -9,9 +9,11 @@ from .core import (
     SignedHellingerMapper,
     StandardScaler,
     StandardScalerModel,
+    TermFrequency,
 )
 
 __all__ = [
+    "TermFrequency",
     "ColumnSampler",
     "CosineRandomFeatures",
     "LinearRectifier",

@@ -82,6 +82,24 @@ class SignedHellingerMapper(Transformer):
         return jnp.sign(X) * jnp.sqrt(jnp.abs(X))
 
 
+class TermFrequency(Transformer):
+    """Seq of terms → (unique term, weighting(count)) pairs
+    (parity: TermFrequency.scala:18-21). ``fun`` maps the raw count, e.g.
+    ``TermFrequency(lambda x: math.log(x) + 1)``; defaults to identity."""
+
+    def __init__(self, fun=None):
+        self.fun = fun
+
+    def apply(self, terms):
+        from collections import Counter
+
+        fun = self.fun or (lambda x: x)
+        counts = Counter(
+            tuple(t) if isinstance(t, list) else t for t in terms
+        )
+        return [(term, float(fun(c))) for term, c in counts.items()]
+
+
 class CosineRandomFeatures(Transformer):
     """Random Fourier features cos(x Wᵀ + b)
     (parity: CosineRandomFeatures.scala:19-44; batched GEMM is the reference's

@@ -13,8 +13,16 @@ from .core import (
     VectorCombiner,
     VectorSplitter,
 )
+from .sparse_features import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseFeatureVectorizer,
+)
 
 __all__ = [
+    "AllSparseFeatures",
+    "CommonSparseFeatures",
+    "SparseFeatureVectorizer",
     "Cacher",
     "ClassLabelIndicators",
     "Densify",

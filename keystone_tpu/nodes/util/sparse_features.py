@@ -1,0 +1,89 @@
+"""Sparse feature-space selection and vectorization.
+
+Parity: nodes/util/CommonSparseFeatures.scala:19-67,
+AllSparseFeatures.scala:15-28, SparseFeatureVectorizer.scala:7-21.
+
+This is the SURVEY §7 "sparse text features" decision point. The reference
+emits breeze SparseVectors; here the vectorizer emits a padded-COO
+``SparseRows`` batch (data/sparse.py) whose consumers run as dense
+gathers/scatters on the MXU. Top-K selection bounds the feature space, so
+rows keep a small static capacity and XLA never sees dynamic sparsity.
+
+Deterministic ordering parity: features are ranked by (count desc, first
+appearance asc) exactly like the reference's (frequency, uniqueId) ordering
+(CommonSparseFeatures.scala:21-44); AllSparseFeatures orders by first
+appearance (AllSparseFeatures.scala:20-26).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from ...data.dataset import Dataset
+from ...data.sparse import SparseRows
+from ...workflow.transformer import Estimator, Transformer
+
+
+class SparseFeatureVectorizer(Transformer):
+    """Map (feature, value) pair lists into the fitted feature space
+    (parity: SparseFeatureVectorizer.scala:7-21)."""
+
+    def __init__(self, feature_space: Dict):
+        self.feature_space = dict(feature_space)
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_space)
+
+    def apply(self, pairs: Sequence[Tuple]) -> List[Tuple[int, float]]:
+        fs = self.feature_space
+        out = [(fs[f], float(v)) for f, v in pairs if f in fs]
+        out.sort()
+        return out
+
+    def apply_batch(self, data) -> Dataset:
+        data = Dataset.of(data)
+        rows = [self.apply(doc) for doc in data]
+        return Dataset(
+            SparseRows.from_pairs(rows, self.num_features), batched=True
+        )
+
+
+class CommonSparseFeatures(Estimator):
+    """Keep the ``num_features`` most frequently observed features
+    (parity: CommonSparseFeatures.scala:19-67)."""
+
+    def __init__(self, num_features: int):
+        self.num_features = num_features
+
+    def fit(self, data: Dataset) -> SparseFeatureVectorizer:
+        data = Dataset.of(data)
+        counts: Dict = {}
+        first_seen: Dict = {}
+        uid = 0
+        for doc in data:
+            for feature, _value in doc:
+                counts[feature] = counts.get(feature, 0) + 1
+                if feature not in first_seen:
+                    first_seen[feature] = uid
+                uid += 1
+        ranked = sorted(
+            counts.keys(), key=lambda f: (-counts[f], first_seen[f])
+        )[: self.num_features]
+        return SparseFeatureVectorizer(
+            {f: i for i, f in enumerate(ranked)}
+        )
+
+
+class AllSparseFeatures(Estimator):
+    """Keep every observed feature, ordered by first appearance
+    (parity: AllSparseFeatures.scala:15-28)."""
+
+    def fit(self, data: Dataset) -> SparseFeatureVectorizer:
+        data = Dataset.of(data)
+        feature_space: Dict = {}
+        for doc in data:
+            for feature, _value in doc:
+                if feature not in feature_space:
+                    feature_space[feature] = len(feature_space)
+        return SparseFeatureVectorizer(feature_space)

@@ -1,0 +1,124 @@
+"""AmazonReviewsPipeline — binary sentiment classification of product
+reviews with n-gram TF features and logistic regression.
+
+Parity: pipelines/text/AmazonReviewsPipeline.scala:16-80. Pipeline:
+Trim → LowerCase → Tokenizer → NGramsFeaturizer(1..nGrams) →
+TermFrequency(x→1) → (CommonSparseFeatures(commonFeatures), train) →
+(LogisticRegressionEstimator(2, numIters), train, labels),
+evaluated with BinaryClassifierEvaluator.
+
+Like Newsgroups, the string stages are host-side; the vectorized rows are a
+padded-COO SparseRows batch and the logistic LBFGS gradient runs sparse on
+device (gather forward, scatter-add backward)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..evaluation.binary import BinaryClassifierEvaluator
+from ..loaders.text import load_amazon_reviews
+from ..nodes.learning import LogisticRegressionEstimator
+from ..nodes.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
+from ..nodes.stats import TermFrequency
+from ..nodes.util import CommonSparseFeatures
+
+
+@dataclass
+class AmazonReviewsConfig:
+    """Parity: AmazonReviewsConfig (AmazonReviewsPipeline.scala:48-56)."""
+
+    train_location: str = ""
+    test_location: str = ""
+    threshold: float = 3.5
+    n_grams: int = 2
+    common_features: int = 100_000
+    num_iters: int = 20
+
+
+def build_predictor(train_docs, train_labels, conf: AmazonReviewsConfig):
+    return (
+        Trim()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(list(range(1, conf.n_grams + 1))))
+        .and_then(TermFrequency(lambda x: 1))
+        .and_then(CommonSparseFeatures(conf.common_features), train_docs)
+        .and_then(
+            LogisticRegressionEstimator(2, num_iters=conf.num_iters),
+            train_docs,
+            train_labels,
+        )
+    )
+
+
+def run(train, test, conf: AmazonReviewsConfig):
+    """Returns (predictor, BinaryMetrics, seconds)."""
+    start = time.perf_counter()
+    predictor = build_predictor(train.data, train.labels, conf)
+    test_results = np.asarray(predictor(test.data).get().to_array())
+    evaluation = BinaryClassifierEvaluator().evaluate(
+        test_results > 0, np.asarray(test.labels.to_array()) > 0
+    )
+    return predictor, evaluation, time.perf_counter() - start
+
+
+def synthetic_reviews(n: int, seed: int = 0):
+    """Positive/negative keyword-bearing synthetic reviews."""
+    rng = np.random.default_rng(seed)
+    pos = ["great", "excellent", "love", "perfect", "wonderful", "best"]
+    neg = ["terrible", "awful", "hate", "broken", "worst", "refund"]
+    filler = [f"item{j}" for j in range(40)]
+    docs, labels = [], []
+    for _ in range(n):
+        y = int(rng.integers(0, 2))
+        kw = pos if y else neg
+        words = [kw[rng.integers(0, len(kw))]
+                 for _ in range(rng.integers(2, 6))]
+        words += [filler[rng.integers(0, len(filler))]
+                  for _ in range(rng.integers(8, 20))]
+        rng.shuffle(words)
+        docs.append(" ".join(words))
+        labels.append(y)
+    from ..loaders.csv_loader import LabeledData
+
+    return LabeledData(
+        np.asarray(labels, dtype=np.int32), Dataset.from_items(docs)
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("AmazonReviewsPipeline")
+    p.add_argument("--trainLocation", default=None)
+    p.add_argument("--testLocation", default=None)
+    p.add_argument("--threshold", type=float, default=3.5)
+    p.add_argument("--nGrams", type=int, default=2)
+    p.add_argument("--commonFeatures", type=int, default=100_000)
+    p.add_argument("--numIters", type=int, default=20)
+    args = p.parse_args(argv)
+    conf = AmazonReviewsConfig(
+        train_location=args.trainLocation or "",
+        test_location=args.testLocation or "",
+        threshold=args.threshold,
+        n_grams=args.nGrams,
+        common_features=args.commonFeatures,
+        num_iters=args.numIters,
+    )
+    if args.trainLocation:
+        train = load_amazon_reviews(args.trainLocation, conf.threshold)
+        test = load_amazon_reviews(args.testLocation, conf.threshold)
+    else:
+        train = synthetic_reviews(512, seed=1)
+        test = synthetic_reviews(128, seed=2)
+    _, evaluation, seconds = run(train, test, conf)
+    print(evaluation.summary())
+    print(f"Pipeline took {seconds} s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,83 @@
+"""StupidBackoffPipeline — n-gram language model training.
+
+Parity: pipelines/nlp/StupidBackoffPipeline.scala:9-59. Steps:
+Tokenizer → WordFrequencyEncoder (vocab by frequency rank) →
+NGramsFeaturizer(2..n) over encoded ids → NGramsCounts(noAdd) →
+StupidBackoffEstimator(unigramCounts). Prints corpus stats and sample
+scores like the reference driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..nodes.nlp import (
+    NGramsCounts,
+    NGramsFeaturizer,
+    StupidBackoffEstimator,
+    StupidBackoffModel,
+    Tokenizer,
+    WordFrequencyEncoder,
+)
+
+
+def train_language_model(lines, n: int = 3) -> StupidBackoffModel:
+    """lines: iterable of raw text lines → fitted StupidBackoffModel over
+    frequency-encoded word ids."""
+    tok = Tokenizer()
+    text = Dataset.from_items([tok.apply(line) for line in lines])
+    frequency_encode = WordFrequencyEncoder().fit(text)
+    unigram_counts = frequency_encode.unigram_counts
+
+    encoded = Dataset.from_items(
+        [frequency_encode.apply(doc) for doc in text]
+    )
+    featurizer = NGramsFeaturizer(list(range(2, n + 1)))
+    ngram_counts = NGramsCounts("noadd").apply_batch(
+        Dataset.from_items([featurizer.apply(doc) for doc in encoded])
+    )
+    return StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
+
+
+def synthetic_corpus(n_lines: int = 200, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(60)]
+    lines = []
+    for _ in range(n_lines):
+        ln = rng.integers(4, 14)
+        # zipf-ish draws so frequency ranks are nontrivial
+        ids = np.minimum(
+            rng.zipf(1.5, size=ln) - 1, len(vocab) - 1
+        ).astype(int)
+        lines.append(" ".join(vocab[i] for i in ids))
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("StupidBackoffPipeline")
+    p.add_argument("--trainData", default=None)
+    p.add_argument("--n", type=int, default=3)
+    args = p.parse_args(argv)
+    if args.trainData:
+        with open(args.trainData) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+    else:
+        lines = synthetic_corpus()
+    t0 = time.perf_counter()
+    lm = train_language_model(lines, n=args.n)
+    print(f"number of tokens: {lm.num_tokens}")
+    print(f"size of vocabulary: {len(lm.unigram_counts)}")
+    print(f"number of ngrams: {len(lm.scores)}")
+    print("trained scores of 100 ngrams in the corpus:")
+    for ngram, score in list(lm.scores.items())[:100]:
+        print(ngram, score)
+    print(f"Pipeline took {time.perf_counter() - t0} s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,383 @@
+"""NLP stack tests, mirroring the reference suites:
+StringUtilsSuite, NGramSuite, HashingTFSuite, NGramsHashingTFSuite,
+NGramIndexerSuite, WordFrequencyEncoderSuite, StupidBackoffSuite,
+CommonSparseFeaturesSuite — plus SparseRows numeric oracles."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.data.sparse import SparseRows
+from keystone_tpu.nodes.nlp import (
+    HashingTF,
+    LowerCase,
+    NaiveBitPackIndexer,
+    NGramIndexerImpl,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    StupidBackoffEstimator,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+    java_string_hash,
+)
+from keystone_tpu.nodes.stats import TermFrequency
+from keystone_tpu.nodes.util import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+)
+
+
+# ---- StringUtilsSuite ----------------------------------------------------
+
+STRINGS = ["  The quick BROWN fo.X ", " ! !.,)JumpeD. ovER the LAZy DOG.. ! "]
+
+
+def test_trim():
+    out = [Trim().apply(s) for s in STRINGS]
+    assert out == ["The quick BROWN fo.X", "! !.,)JumpeD. ovER the LAZy DOG.. !"]
+
+
+def test_lower_case():
+    out = [LowerCase().apply(s) for s in STRINGS]
+    assert out == [
+        "  the quick brown fo.x ",
+        " ! !.,)jumped. over the lazy dog.. ! ",
+    ]
+
+
+def test_tokenizer():
+    # parity: StringUtilsSuite "tokenizer" — leading empty token kept,
+    # trailing separators dropped (Java String.split semantics)
+    out = [Tokenizer().apply(s) for s in STRINGS]
+    assert out == [
+        ["", "The", "quick", "BROWN", "fo", "X"],
+        ["", "JumpeD", "ovER", "the", "LAZy", "DOG"],
+    ]
+
+
+# ---- NGramSuite ----------------------------------------------------------
+
+DOCS = ["Pipelines are awesome", "NLP is awesome"]
+
+
+def _tokens(doc):
+    return Tokenizer().apply(doc)
+
+
+def test_ngrams_featurizer():
+    uni = [NGramsFeaturizer([1]).apply(_tokens(d)) for d in DOCS]
+    assert uni == [
+        [("Pipelines",), ("are",), ("awesome",)],
+        [("NLP",), ("is",), ("awesome",)],
+    ]
+    bt = [NGramsFeaturizer([2, 3]).apply(_tokens(d)) for d in DOCS]
+    assert bt == [
+        [("Pipelines", "are"), ("Pipelines", "are", "awesome"),
+         ("are", "awesome")],
+        [("NLP", "is"), ("NLP", "is", "awesome"), ("is", "awesome")],
+    ]
+    assert [NGramsFeaturizer([6]).apply(_tokens(d)) for d in DOCS] == [[], []]
+
+
+def test_ngrams_counts():
+    grams = Dataset.from_items(
+        [NGramsFeaturizer([1]).apply(_tokens(d)) for d in DOCS]
+    )
+    counts = dict(NGramsCounts().apply_batch(grams).collect())
+    assert counts == {
+        ("awesome",): 2, ("Pipelines",): 1, ("are",): 1,
+        ("NLP",): 1, ("is",): 1,
+    }
+    # sorted descending by count
+    ordered = NGramsCounts().apply_batch(grams).collect()
+    assert ordered[0] == (("awesome",), 2)
+    grams23 = Dataset.from_items(
+        [NGramsFeaturizer([2, 3]).apply(_tokens(d)) for d in DOCS]
+    )
+    assert all(c == 1 for _, c in NGramsCounts().apply_batch(grams23).collect())
+
+
+# ---- HashingTFSuite ------------------------------------------------------
+
+def test_java_string_hash():
+    # golden values from java.lang.String.hashCode
+    assert java_string_hash("") == 0
+    assert java_string_hash("a") == 97
+    assert java_string_hash("abc") == 96354
+    assert java_string_hash("hello") == 99162322
+    # the famous Integer.MIN_VALUE string (32-bit overflow behavior)
+    assert java_string_hash("polygenelubricants") == -2147483648
+
+
+def test_hashing_tf_no_collisions():
+    dims = 4000
+    row = HashingTF(dims).apply(["1", "2", "4", "4", "4", "4", "2"])
+    assert len(row) == 3
+    assert sorted(v for _, v in row) == [1.0, 2.0, 4.0]
+
+
+def test_hashing_tf_collisions():
+    row = HashingTF(2).apply(["1", "2", "4", "4", "4", "4", "2"])
+    assert len(row) <= 2
+    assert sum(v for _, v in row) == 7.0
+
+
+def test_ngrams_hashing_tf_equals_composed():
+    # parity: NGramsHashingTFSuite — rolling hash must equal
+    # NGramsFeaturizer andThen HashingTF exactly
+    line = Tokenizer().apply("a quick brown fox jumped over a lazy dog a a")
+    for orders in ([1], [1, 2], [2, 3], [1, 2, 3, 4]):
+        for dims in (64, 4096):
+            composed = HashingTF(dims).apply(
+                NGramsFeaturizer(orders).apply(line)
+            )
+            rolling = NGramsHashingTF(orders, dims).apply(line)
+            assert rolling == composed, (orders, dims)
+
+
+# ---- NGramIndexerSuite ---------------------------------------------------
+
+def test_bitpack_pack():
+    assert NaiveBitPackIndexer.pack([1]) == 2**40
+    assert NaiveBitPackIndexer.pack([1, 1]) == 2**40 + 2**20 + 2**60
+    assert NaiveBitPackIndexer.pack([1, 1, 1]) == 1 + 2**40 + 2**20 + 2**61
+    assert NGramIndexerImpl.pack(range(1, 6)) == (1, 2, 3, 4, 5)
+
+
+@pytest.mark.parametrize("indexer", [NaiveBitPackIndexer, NGramIndexerImpl])
+def test_remove_farthest_word(indexer):
+    assert indexer.remove_farthest_word(indexer.pack([1, 2, 3])) == \
+        indexer.pack([2, 3])
+    assert indexer.remove_farthest_word(indexer.pack([1, 2])) == \
+        indexer.pack([2])
+
+
+@pytest.mark.parametrize("indexer", [NaiveBitPackIndexer, NGramIndexerImpl])
+def test_remove_current_word(indexer):
+    assert indexer.remove_current_word(indexer.pack([1, 2, 3])) == \
+        indexer.pack([1, 2])
+    assert indexer.remove_current_word(indexer.pack([1, 2])) == \
+        indexer.pack([1])
+
+
+def test_bitpack_batch_roundtrip():
+    rng = np.random.default_rng(0)
+    for order in (1, 2, 3):
+        words = rng.integers(0, 2**20, size=(100, order))
+        packed = NaiveBitPackIndexer.pack_batch(words, order)
+        scalar = np.array(
+            [NaiveBitPackIndexer.pack(list(w)) for w in words]
+        )
+        assert np.array_equal(packed, scalar)
+        unpacked, orders = NaiveBitPackIndexer.unpack_batch(packed)
+        assert np.all(orders == order)
+        assert np.array_equal(unpacked[:, :order], words)
+
+
+# ---- WordFrequencyEncoderSuite -------------------------------------------
+
+def test_word_frequency_encoder():
+    text = ["Winter coming", "Winter Winter is coming"]
+    docs = Dataset.from_items([_tokens(t) for t in text])
+    encoder = WordFrequencyEncoder().fit(docs)
+    assert [encoder.apply(_tokens(t)) for t in text] == [[0, 1], [0, 0, 2, 1]]
+    assert encoder.unigram_counts == {0: 3, 1: 2, 2: 1}
+    assert encoder.apply(["hi"]) == [-1]
+
+
+# ---- StupidBackoffSuite --------------------------------------------------
+
+def _stupid_backoff_lm():
+    data = ["Winter is coming", "Finals are coming",
+            "Summer is coming really soon"]
+    docs = [_tokens(d) for d in data]
+    ngrams = NGramsCounts("noadd").apply_batch(
+        Dataset.from_items(
+            [NGramsFeaturizer(list(range(2, 6))).apply(d) for d in docs]
+        )
+    )
+    unigrams = {
+        gram[0]: c
+        for gram, c in NGramsCounts().apply_batch(
+            Dataset.from_items(
+                [NGramsFeaturizer([1]).apply(d) for d in docs]
+            )
+        ).collect()
+    }
+    return StupidBackoffEstimator(unigrams).fit(ngrams)
+
+
+def test_stupid_backoff_scores():
+    lm = _stupid_backoff_lm()
+    assert lm.score(("is", "coming")) == 2.0 / 2.0
+    assert lm.score(("is", "coming", "really")) == 1.0 / 2.0
+    assert lm.score(("is", "unseen-coming")) == 0.0
+    assert lm.score(("is-unseen", "coming")) == \
+        lm.alpha * 3.0 / lm.num_tokens
+
+
+def test_stupid_backoff_fitted_scores_in_unit_interval():
+    lm = _stupid_backoff_lm()
+    assert lm.scores
+    assert all(0.0 <= s <= 1.0 for s in lm.scores.values())
+
+
+# ---- sparse features -----------------------------------------------------
+
+def test_term_frequency():
+    tf = dict(TermFrequency().apply(["a", "b", "a", "a", "c", "b"]))
+    assert tf == {"a": 3.0, "b": 2.0, "c": 1.0}
+    tf_log = dict(
+        TermFrequency(lambda x: x * 10).apply(["a", "b", "a"])
+    )
+    assert tf_log == {"a": 20.0, "b": 10.0}
+
+
+def test_common_sparse_features_ordering():
+    # count desc, ties broken by first appearance in the stream
+    docs = Dataset.from_items([
+        [("x", 1.0), ("y", 2.0)],
+        [("y", 1.0), ("z", 5.0)],
+        [("w", 1.0)],
+    ])
+    vec = CommonSparseFeatures(2).fit(docs)
+    # y appears twice; x/z/w once each — x is earliest
+    assert vec.feature_space == {"y": 0, "x": 1}
+    row = vec.apply([("z", 9.0), ("y", 4.0), ("x", 3.0)])
+    assert row == [(0, 4.0), (1, 3.0)]  # z filtered out
+
+
+def test_all_sparse_features_first_appearance_order():
+    docs = Dataset.from_items([
+        [("b", 1.0)], [("a", 1.0), ("b", 2.0)], [("c", 3.0)],
+    ])
+    vec = AllSparseFeatures().fit(docs)
+    assert vec.feature_space == {"b": 0, "a": 1, "c": 2}
+
+
+def test_sparse_rows_numeric_oracle():
+    rng = np.random.default_rng(0)
+    n, d, k = 12, 37, 5
+    dense = np.zeros((n, d), dtype=np.float32)
+    rows = []
+    for i in range(n):
+        nnz = rng.integers(0, 9)
+        idx = rng.choice(d, size=nnz, replace=False)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        dense[i, idx] = vals
+        rows.append(list(zip(idx.tolist(), vals.tolist())))
+    sr = SparseRows.from_pairs(rows, d)
+    assert sr.shape == (n, d)
+    np.testing.assert_allclose(np.asarray(sr.to_dense()), dense, atol=1e-6)
+
+    W = rng.standard_normal((d, k)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sr.matmul(W)), dense @ W, rtol=1e-4, atol=1e-5
+    )
+    R = rng.standard_normal((n, k)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sr.rmatmul(R)), dense.T @ R, rtol=1e-4, atol=1e-5
+    )
+    onehot = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=n)]
+    np.testing.assert_allclose(
+        np.asarray(sr.class_sums(onehot)), onehot.T @ dense,
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_sparse_rows_scipy_roundtrip():
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.default_rng(1)
+    mat = sp.random(20, 50, density=0.1, random_state=2, format="csr")
+    sr = SparseRows.from_scipy(mat)
+    np.testing.assert_allclose(
+        np.asarray(sr.to_dense()), mat.toarray(), atol=1e-6
+    )
+
+
+# ---- sparse solver agreement (distributed-vs-local oracle family) --------
+
+def _random_sparse_problem(seed=0, n=64, d=40, k=3):
+    rng = np.random.default_rng(seed)
+    dense = np.zeros((n, d), dtype=np.float32)
+    rows = []
+    for i in range(n):
+        nnz = rng.integers(2, 10)
+        idx = rng.choice(d, size=nnz, replace=False)
+        vals = rng.uniform(0.5, 2.0, nnz).astype(np.float32)
+        dense[i, idx] = vals
+        rows.append(list(zip(idx.tolist(), vals.tolist())))
+    sr = SparseRows.from_pairs(rows, d)
+    y = rng.integers(0, k, size=n)
+    return sr, dense, y
+
+
+def test_naive_bayes_sparse_equals_dense():
+    from keystone_tpu.nodes.learning import NaiveBayesEstimator
+
+    sr, dense, y = _random_sparse_problem()
+    m_sparse = NaiveBayesEstimator(3).fit(
+        Dataset(sr, batched=True), Dataset.of(np.asarray(y))
+    )
+    m_dense = NaiveBayesEstimator(3).fit(
+        Dataset.of(dense), Dataset.of(np.asarray(y))
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_sparse.theta), np.asarray(m_dense.theta),
+        rtol=1e-5, atol=1e-6,
+    )
+    # sparse apply path agrees with dense scoring
+    out_sparse = np.asarray(
+        m_sparse.apply_batch(Dataset(sr, batched=True)).to_array()
+    )
+    out_dense = np.asarray(m_dense.trace_batch(dense))
+    np.testing.assert_allclose(out_sparse, out_dense, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_lbfgs_matches_dense_lbfgs():
+    from keystone_tpu.nodes.learning import (
+        DenseLBFGSwithL2,
+        SparseLBFGSwithL2,
+    )
+
+    sr, dense, y = _random_sparse_problem(seed=3)
+    B = np.eye(3, dtype=np.float32)[y] * 2 - 1
+    m_sparse = SparseLBFGSwithL2(reg_param=0.1, num_iterations=60).fit(
+        Dataset(sr, batched=True), Dataset.of(B)
+    )
+    m_dense = DenseLBFGSwithL2(reg_param=0.1, num_iterations=60).fit(
+        Dataset.of(dense), Dataset.of(B)
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_sparse.W), np.asarray(m_dense.W), rtol=5e-2, atol=5e-3
+    )
+    # SparseLinearMapper apply == dense LinearMapper apply
+    out_sparse = np.asarray(
+        m_sparse.apply_batch(Dataset(sr, batched=True)).to_array()
+    )
+    np.testing.assert_allclose(
+        out_sparse, dense @ np.asarray(m_sparse.W), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_logistic_regression_sparse_learns():
+    from keystone_tpu.nodes.learning import LogisticRegressionEstimator
+
+    rng = np.random.default_rng(5)
+    n, d = 200, 30
+    y = rng.integers(0, 2, size=n)
+    rows = []
+    for i in range(n):
+        # class signal: feature y*3 present with high value
+        idx = [int(y[i]) * 3, int(rng.integers(6, d))]
+        rows.append([(idx[0], 3.0), (idx[1], 1.0)])
+    sr = SparseRows.from_pairs(rows, d)
+    model = LogisticRegressionEstimator(2, num_iters=40).fit(
+        Dataset(sr, batched=True), Dataset.of(np.asarray(y))
+    )
+    pred = np.asarray(
+        model.apply_batch(Dataset(sr, batched=True)).to_array()
+    )
+    assert (pred == y).mean() > 0.95

@@ -1,0 +1,148 @@
+"""Text pipelines end-to-end (parity slices: NewsgroupsPipeline.scala,
+AmazonReviewsPipeline.scala, StupidBackoffPipeline.scala) + the loaders
+and evaluators they exercise."""
+
+import json
+import os
+
+import numpy as np
+
+from keystone_tpu.evaluation import (
+    AugmentedExamplesEvaluator,
+    BinaryClassifierEvaluator,
+)
+from keystone_tpu.loaders.text import (
+    load_amazon_reviews,
+    load_newsgroups,
+    load_timit_features,
+)
+
+
+def test_newsgroups_pipeline_synthetic():
+    from keystone_tpu.pipelines.newsgroups import (
+        NewsgroupsConfig,
+        run,
+        synthetic_newsgroups,
+    )
+
+    train = synthetic_newsgroups(256, num_classes=6, seed=1)
+    test = synthetic_newsgroups(96, num_classes=6, seed=2)
+    conf = NewsgroupsConfig(n_grams=2, common_features=2000, num_classes=6)
+    _, evaluation, _ = run(train, test, conf)
+    # keyword classes are separable; random would err ~83%
+    assert evaluation.total_error < 0.15, evaluation.summary()
+
+
+def test_newsgroups_loader_and_pipeline_from_dirs(tmp_path):
+    from keystone_tpu.pipelines.newsgroups import (
+        NewsgroupsConfig,
+        run,
+        synthetic_newsgroups,
+    )
+
+    # write a small 2-class on-disk corpus in the expected layout
+    data = synthetic_newsgroups(60, num_classes=2, seed=3)
+    classes = ["comp.graphics", "comp.os.ms-windows.misc"]
+    for split in ("train", "test"):
+        for c in classes:
+            os.makedirs(tmp_path / split / c, exist_ok=True)
+    docs = data.data.collect()
+    labels = np.asarray(data.labels.to_array())
+    for i, (doc, lab) in enumerate(zip(docs, labels)):
+        split = "train" if i < 40 else "test"
+        with open(tmp_path / split / classes[lab] / f"{i}.txt", "w") as f:
+            f.write(doc)
+    train = load_newsgroups(str(tmp_path / "train"))
+    test = load_newsgroups(str(tmp_path / "test"))
+    assert len(train.data) == 40 and len(test.data) == 20
+    conf = NewsgroupsConfig(n_grams=1, common_features=500, num_classes=2)
+    _, evaluation, _ = run(train, test, conf)
+    assert evaluation.total_error < 0.25
+
+
+def test_amazon_reviews_pipeline_synthetic():
+    from keystone_tpu.pipelines.amazon_reviews import (
+        AmazonReviewsConfig,
+        run,
+        synthetic_reviews,
+    )
+
+    train = synthetic_reviews(256, seed=1)
+    test = synthetic_reviews(96, seed=2)
+    conf = AmazonReviewsConfig(n_grams=2, common_features=2000, num_iters=30)
+    _, evaluation, _ = run(train, test, conf)
+    assert evaluation.accuracy > 0.9, evaluation.summary()
+
+
+def test_amazon_loader(tmp_path):
+    recs = [
+        {"overall": 5.0, "reviewText": "great product love it"},
+        {"overall": 1.0, "reviewText": "terrible broken refund"},
+        {"overall": 4.0, "reviewText": "pretty good"},
+        {"overall": 2.0, "reviewText": "not great"},
+    ]
+    path = tmp_path / "reviews.json"
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    ld = load_amazon_reviews(str(path), threshold=3.5)
+    assert np.asarray(ld.labels.to_array()).tolist() == [1, 0, 1, 0]
+    assert ld.data.collect()[0] == "great product love it"
+
+
+def test_timit_loader(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((6, 8)).astype(np.float32)
+    np.savetxt(tmp_path / "train.csv", X, delimiter=",")
+    with open(tmp_path / "train.labels", "w") as f:
+        for i in range(6):
+            f.write(f"{i + 1} {(i % 3) + 1}\n")  # 1-indexed rows and labels
+    data = load_timit_features(
+        str(tmp_path / "train.csv"), str(tmp_path / "train.labels"),
+        str(tmp_path / "train.csv"), str(tmp_path / "train.labels"),
+    )
+    assert np.asarray(data.train.labels.to_array()).tolist() == \
+        [0, 1, 2, 0, 1, 2]
+    np.testing.assert_allclose(
+        np.asarray(data.train.data.to_array()), X, rtol=1e-5
+    )
+
+
+def test_stupid_backoff_pipeline():
+    from keystone_tpu.pipelines.stupid_backoff_pipeline import (
+        synthetic_corpus,
+        train_language_model,
+    )
+
+    lm = train_language_model(synthetic_corpus(100, seed=4), n=3)
+    assert lm.num_tokens > 0
+    assert len(lm.scores) > 0
+    assert all(0.0 <= s <= 1.0 for s in lm.scores.values())
+    # scoring an in-corpus bigram of encoded ids works
+    some_bigram = next(g for g in lm.scores if len(g) == 2)
+    assert lm.score(some_bigram) > 0
+
+
+def test_binary_evaluator_oracle():
+    preds = np.array([True, True, False, False, True])
+    acts = np.array([True, False, False, True, True])
+    m = BinaryClassifierEvaluator().evaluate(preds, acts)
+    assert (m.tp, m.fp, m.tn, m.fn) == (2.0, 1.0, 1.0, 1.0)
+    assert m.accuracy == 0.6
+    assert abs(m.f_score() - 2 * 2 / (2 * 2 + 1 + 1)) < 1e-12
+
+
+def test_augmented_evaluator_average_and_borda():
+    # two sources, two augmented copies each, 3 classes
+    names = ["a", "a", "b", "b"]
+    preds = np.array([
+        [0.9, 0.1, 0.0],
+        [0.5, 0.3, 0.2],   # "a" → class 0 under both policies
+        [0.0, 0.4, 0.6],
+        [0.1, 0.2, 0.7],   # "b" → class 2 under both policies
+    ])
+    actuals = np.array([0, 0, 2, 2])
+    m = AugmentedExamplesEvaluator(names, 3, "average").evaluate(preds, actuals)
+    assert m.total_error == 0.0
+    m2 = AugmentedExamplesEvaluator(names, 3, "borda").evaluate(preds, actuals)
+    assert m2.total_error == 0.0
