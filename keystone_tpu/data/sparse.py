@@ -19,7 +19,7 @@ capacity, and everything downstream is gathers/scatters XLA tiles well.
 
 from __future__ import annotations
 
-from typing import Iterable, List, Sequence, Tuple
+from typing import Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -147,6 +147,25 @@ class SparseRows:
     def density(self) -> float:
         n, d = self.shape
         return self.nnz / float(max(n * d, 1))
+
+    @staticmethod
+    def datum_from_pairs(x, num_features: int) -> Optional["SparseRows"]:
+        """Interpret a per-datum value as a 1-row SparseRows when it is a
+        sparse (index, value) pair list (what SparseFeatureVectorizer /
+        HashingTF emit per item — the reference's SparseVector role).
+        Returns None when ``x`` is not pair-shaped."""
+        if isinstance(x, SparseRows):
+            return x
+        if isinstance(x, (list, tuple)) and (
+            len(x) == 0
+            or (
+                isinstance(x[0], (tuple, list))
+                and len(x[0]) == 2
+                and isinstance(x[0][0], (int, np.integer))
+            )
+        ):
+            return SparseRows.from_pairs([x], num_features)
+        return None
 
     def __getitem__(self, i) -> "SparseRows":
         sl = self.indices[i], self.values[i]

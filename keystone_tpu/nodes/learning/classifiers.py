@@ -17,6 +17,7 @@ import numpy as np
 
 from ...data.dataset import Dataset
 from ...parallel.mesh import default_mesh, shard_batch
+from ...workflow.node_optimization import Optimizable
 from ...workflow.transformer import LabelEstimator, Transformer
 from .cost import CostModel
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, minimize_lbfgs
@@ -43,6 +44,14 @@ class NaiveBayesModel(Transformer):
                 data.payload.matmul(self.theta.T) + self.pi, batched=True
             )
         return super().apply_batch(data)
+
+    def apply(self, x):
+        from ...data.sparse import SparseRows
+
+        sr = SparseRows.datum_from_pairs(x, self.theta.shape[1])
+        if sr is not None:
+            return (sr.matmul(self.theta.T) + self.pi)[0]
+        return super().apply(x)
 
 
 class NaiveBayesEstimator(LabelEstimator):
@@ -123,6 +132,14 @@ class LogisticRegressionModel(Transformer):
                 batched=True,
             )
         return super().apply_batch(data)
+
+    def apply(self, x):
+        from ...data.sparse import SparseRows
+
+        sr = SparseRows.datum_from_pairs(x, self.W.shape[0])
+        if sr is not None:
+            return jnp.argmax(sr.matmul(self.W), axis=-1)[0]
+        return super().apply(x)
 
     def scores(self, X):
         return jnp.asarray(X) @ self.W
@@ -212,11 +229,12 @@ class LinearDiscriminantAnalysis(LabelEstimator):
         return LinearMapper(jnp.asarray(W, dtype=jnp.float32))
 
 
-class LeastSquaresEstimator(LabelEstimator, CostModel):
+class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
     """Cost-model auto-selecting least squares solver
     (parity: LeastSquaresEstimator.scala:26-88; option set preserved:
     dense LBFGS, sparse LBFGS, block solver (1000, 3), exact normal
-    equations)."""
+    equations). Participates in graph-level NodeOptimizationRule via
+    ``sample_optimize`` (parity: OptimizableNodes.scala:27-40)."""
 
     def __init__(self, lam: float = 0.0, num_machines: Optional[int] = None,
                  cpu_weight: float = 3.8e-4, mem_weight: float = 2.9e-1,
@@ -238,8 +256,14 @@ class LeastSquaresEstimator(LabelEstimator, CostModel):
     def weight(self) -> int:
         return self.default.weight
 
+    def sample_optimize(self, samples, num_items: int):
+        """Graph-level entry: pick the concrete solver from dependency
+        samples + the full dataset size."""
+        data_sample, label_sample = samples[0], samples[1]
+        return self.optimize(data_sample, label_sample, total_n=num_items)
+
     def optimize(self, sample: Dataset, sample_labels: Dataset,
-                 num_per_partition=None) -> LabelEstimator:
+                 total_n: Optional[int] = None) -> LabelEstimator:
         from ...data.sparse import SparseRows
 
         sample = Dataset.of(sample)
@@ -258,7 +282,10 @@ class LeastSquaresEstimator(LabelEstimator, CostModel):
             else:
                 sparsity = 1.0
                 d = np.asarray(first).shape[-1]
-        n = len(sample)
+        # Scale the sample up to the full dataset size — selecting on the
+        # raw sample size skews toward small-n regimes (the reference uses
+        # numPerPartition × machines, LeastSquaresEstimator.scala:63-66).
+        n = total_n if total_n is not None else len(sample)
         k = np.asarray(sample_labels.first()).shape[-1]
         machines = self.num_machines or default_mesh().size
         return min(

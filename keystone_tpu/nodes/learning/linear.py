@@ -211,6 +211,9 @@ class SparseLinearMapper(Transformer):
     def apply(self, x):
         from ...data.sparse import SparseRows
 
+        sr = SparseRows.datum_from_pairs(x, self.W.shape[0])
+        if sr is not None:
+            x = sr
         if isinstance(x, SparseRows):
             out = x.matmul(self.W)
             out = out if self.b is None else out + self.b

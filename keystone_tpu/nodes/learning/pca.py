@@ -22,6 +22,7 @@ import numpy as np
 from ...data.dataset import Dataset
 from ...linalg.tsqr import tsqr_r
 from ...parallel.mesh import default_mesh
+from ...workflow.node_optimization import Optimizable
 from ...workflow.transformer import Estimator, Transformer
 from .cost import (
     CostModel,
@@ -196,10 +197,12 @@ class DistributedColumnPCAEstimator(Estimator, CostModel, _ColumnFit):
         return self._est.cost(*a)
 
 
-class ColumnPCAEstimator(Estimator, _ColumnFit):
+class ColumnPCAEstimator(Estimator, _ColumnFit, Optimizable):
     """Cost-model chooser between local and distributed column PCA
     (parity: ColumnPCAEstimator, PCA.scala:105-160). Falls back to the local
-    estimator when no sample statistics are available."""
+    estimator when no sample statistics are available. Participates in
+    graph-level NodeOptimizationRule via ``sample_optimize``
+    (parity: OptimizableNodes.scala:12-25)."""
 
     def __init__(
         self,
@@ -217,16 +220,25 @@ class ColumnPCAEstimator(Estimator, _ColumnFit):
         self.local = LocalColumnPCAEstimator(dims)
         self.distributed = DistributedColumnPCAEstimator(dims)
 
-    def optimize(self, sample: Dataset, num_per_partition=None) -> Estimator:
+    def sample_optimize(self, samples, num_items: int) -> Estimator:
+        return self.optimize(samples[0], total_items=num_items)
+
+    def optimize(self, sample: Dataset,
+                 total_items: Optional[int] = None) -> Estimator:
         sample = Dataset.of(sample)
         # shapes only — no device→host materialization of the descriptors
         if sample.is_batched:
             shape = jax.tree_util.tree_leaves(sample.payload)[0].shape
             d, n = shape[1], shape[0] * shape[2]
+            n_sample_items = shape[0]
         else:
             items = sample.payload
             d = items[0].shape[0]
             n = sum(item.shape[1] for item in items)
+            n_sample_items = len(items)
+        if total_items is not None and n_sample_items:
+            # scale descriptor-column count from the sample to the full set
+            n = int(n * total_items / n_sample_items)
         machines = self.num_machines or default_mesh().size
         args = (n, d, self.dims, 1.0, machines,
                 self.cpu_weight, self.mem_weight, self.network_weight)

@@ -127,14 +127,9 @@ class NGramsHashingTF(Transformer):
     (parity: NGramsHashingTF.scala:25-146)."""
 
     def __init__(self, orders: Sequence[int], num_features: int):
-        orders = list(orders)
-        if min(orders) < 1:
-            raise ValueError(f"minimum order is not >= 1, found {min(orders)}")
-        for a, b in zip(orders, orders[1:]):
-            if b != a + 1:
-                raise ValueError(
-                    f"orders are not consecutive; contains {a} and {b}"
-                )
+        from .ngrams import validate_orders
+
+        orders = validate_orders(orders)
         self.orders = orders
         self.min_order = orders[0]
         self.max_order = orders[-1]

@@ -17,19 +17,26 @@ from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
 
 
+def validate_orders(orders: Sequence[int]) -> list:
+    """Shared n-gram order validation (consecutive positive ints) used by
+    NGramsFeaturizer and NGramsHashingTF, which must stay output-identical."""
+    orders = list(orders)
+    if min(orders) < 1:
+        raise ValueError(f"minimum order is not >= 1, found {min(orders)}")
+    for a, b in zip(orders, orders[1:]):
+        if b != a + 1:
+            raise ValueError(
+                f"orders are not consecutive; contains {a} and {b}"
+            )
+    return orders
+
+
 class NGramsFeaturizer(Transformer):
     """Token sequence → all n-grams for consecutive ``orders``
     (parity: NGramsFeaturizer, ngrams.scala:20-97)."""
 
     def __init__(self, orders: Sequence[int]):
-        orders = list(orders)
-        if min(orders) < 1:
-            raise ValueError(f"minimum order is not >= 1, found {min(orders)}")
-        for a, b in zip(orders, orders[1:]):
-            if b != a + 1:
-                raise ValueError(
-                    f"orders are not consecutive; contains {a} and {b}"
-                )
+        orders = validate_orders(orders)
         self.orders = orders
         self.min_order = orders[0]
         self.max_order = orders[-1]
@@ -54,6 +61,7 @@ class NGramsCounts(Transformer):
     NoAdd skips cross-partition aggregation)."""
 
     def __init__(self, mode: str = "default"):
+        mode = mode.lower()
         if mode not in ("default", "noadd"):
             raise ValueError("`mode` must be `default` or `noAdd`")
         self.mode = mode

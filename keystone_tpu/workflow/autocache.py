@@ -1,13 +1,19 @@
-"""Profile-guided materialization planning (parity: ``workflow/AutoCacheRule.scala``).
+"""Profile-guided cache insertion (parity: ``workflow/AutoCacheRule.scala``).
 
-In the reference, RDDs are recomputed per action unless a ``Cacher`` node
-persists them, and AutoCacheRule decides which to cache under a memory budget.
-Here the default executor memoizes every node's result in HBM, so the planner's
-job inverts: decide which intermediates are *worth retaining* versus dropping
-and recomputing under HBM pressure. This module currently implements node
-profiling (wall time + result bytes at sample scales) and the greedy
-runs-x-saved-time selection; the eviction hook lands with the materialization
-planner (see ``docs/ROADMAP.md``).
+In the reference, RDDs recompute per action unless a ``Cacher`` node persists
+them; AutoCacheRule profiles nodes at several sample scales, fits linear
+time/memory-vs-scale models (``generalizeProfiles``,
+AutoCacheRule.scala:104-135), estimates per-node run counts from downstream
+weights (``getRuns`` :57-81), and inserts Cacher nodes — either around
+everything reused (``aggressiveCache`` :503-518) or greedily maximizing saved
+time under a memory budget (``greedyCache`` :559-602).
+
+Here the same algorithm runs over HBM: the executor retains only results
+under a Cacher (plus datasets/fitted estimators) across pulls once this rule
+has run — see ``GraphExecutor`` — so the budget genuinely bounds resident
+bytes, and uncached intermediates recompute exactly like unpersisted RDDs.
+The budget defaults to 75%% of free device memory when the platform reports
+it (parity: 0.75 × cluster free storage, AutoCacheRule.scala:572-585).
 """
 
 from __future__ import annotations
@@ -15,7 +21,7 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -24,10 +30,15 @@ from ..data.dataset import Dataset
 from .executor import GraphExecutor
 from .graph import Graph, NodeId
 from .node_optimization import _sampled_graph
+from .operators import DatasetOperator
 from .rules import Annotations, Rule
 from . import analysis
 
 logger = logging.getLogger(__name__)
+
+#: string key in the annotations dict marking that cache planning ran (the
+#: executor switches from memoize-everything to Cacher-only retention).
+AUTOCACHE_ACTIVE = "autocache_active"
 
 
 @dataclass
@@ -45,16 +56,18 @@ def _result_bytes(value) -> float:
     if isinstance(value, Dataset):
         if value.is_batched:
             return float(
-                sum(np.prod(a.shape) * a.dtype.itemsize for a in jax.tree_util.tree_leaves(value.payload))
+                sum(
+                    np.prod(a.shape) * a.dtype.itemsize
+                    for a in jax.tree_util.tree_leaves(value.payload)
+                )
             )
-        return float(sum(getattr(np.asarray(x), "nbytes", 64) for x in value.collect()))
+        return float(
+            sum(getattr(np.asarray(x), "nbytes", 64) for x in value.collect())
+        )
     return 64.0
 
 
-def profile_nodes(graph: Graph, sample_size: int = 24) -> Dict[NodeId, Profile]:
-    """Execute a leaf-sampled copy of the graph, timing each node and sizing
-    its result (the reference fits linear scale models over several sample
-    fractions; one sample scale + linear extrapolation is used here)."""
+def _profile_at_scale(graph: Graph, sample_size: int) -> Dict[NodeId, Profile]:
     sampled = _sampled_graph(graph, sample_size)
     executor = GraphExecutor(sampled, optimize=False)
     profiles: Dict[NodeId, Profile] = {}
@@ -72,7 +85,48 @@ def profile_nodes(graph: Graph, sample_size: int = 24) -> Dict[NodeId, Profile]:
     return profiles
 
 
-def estimate_runs(graph: Graph, weights: Dict[NodeId, int], cached: set) -> Dict[NodeId, int]:
+def profile_nodes(
+    graph: Graph,
+    sample_sizes: Sequence[int] = (8, 16, 24),
+    full_size: Optional[int] = None,
+) -> Dict[NodeId, Profile]:
+    """Profile at several sample scales and fit a linear model per node,
+    extrapolated to the full input size (parity: ``generalizeProfiles``,
+    AutoCacheRule.scala:104-135 — same least-squares-in-scale idea, with
+    jit warmup noise damped by taking the *minimum* time per scale)."""
+    input_size = _full_input_size(graph)
+    # the truncated leaf size actually run: requested scale capped by the
+    # real dataset size (otherwise the fitted slope uses a wrong Δx)
+    scales = sorted({min(s, input_size) for s in sample_sizes})
+    per_scale = [(s, _profile_at_scale(graph, s)) for s in scales]
+    nodes = set().union(*[set(p.keys()) for _, p in per_scale]) if per_scale else set()
+    out: Dict[NodeId, Profile] = {}
+    for n in nodes:
+        xs, ts, bs = [], [], []
+        for s, profs in per_scale:
+            if n in profs:
+                xs.append(float(s))
+                ts.append(profs[n].ns)
+                bs.append(profs[n].mem_bytes)
+        if not xs:
+            continue
+        target = float(full_size if full_size is not None else max(xs))
+        if len(xs) >= 2 and len(set(xs)) >= 2:
+            A = np.stack([np.ones(len(xs)), np.asarray(xs)], axis=1)
+            t_coef, *_ = np.linalg.lstsq(A, np.asarray(ts), rcond=None)
+            b_coef, *_ = np.linalg.lstsq(A, np.asarray(bs), rcond=None)
+            ns = max(t_coef[0] + t_coef[1] * target, min(ts))
+            mem = max(b_coef[0] + b_coef[1] * target, 0.0)
+        else:
+            scale = target / xs[-1]
+            ns, mem = ts[-1] * scale, bs[-1] * scale
+        out[n] = Profile(float(ns), float(mem))
+    return out
+
+
+def estimate_runs(
+    graph: Graph, weights: Dict[NodeId, int], cached: set
+) -> Dict[NodeId, int]:
     """Times each node runs given which nodes are cached: a node reruns once
     per (weighted) downstream consumer path that is not cut by a cached node
     (parity: ``AutoCacheRule.getRuns``)."""
@@ -100,42 +154,149 @@ def estimate_runs(graph: Graph, weights: Dict[NodeId, int], cached: set) -> Dict
     return runs
 
 
-class AutoCacheRule(Rule):
-    """Greedy cache selection under a byte budget; currently selection is
-    advisory (executor memoizes everything) and is logged for inspection."""
+def _device_budget_bytes() -> int:
+    """75% of free device memory when the backend reports it, else 4 GiB."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use", 0)
+        if limit:
+            return int(0.75 * (limit - in_use))
+    except Exception:
+        pass
+    return 4 << 30
 
-    def __init__(self, strategy: str = "greedy", mem_budget_bytes: Optional[int] = None):
+
+def _is_cacher(op) -> bool:
+    from ..nodes.util.core import Cacher
+
+    return isinstance(op, Cacher)
+
+
+def insert_cachers(graph: Graph, nodes: Sequence[NodeId]) -> Graph:
+    """Splice a Cacher after each selected node, rerouting every consumer
+    (parity: ``addCachesToPipeline``, AutoCacheRule.scala:492-501)."""
+    from ..nodes.util.core import Cacher
+
+    for n in nodes:
+        children = analysis.get_children(graph, n)
+        existing = [
+            c for c in children
+            if isinstance(c, NodeId) and _is_cacher(graph.get_operator(c))
+        ]
+        if existing:
+            # reuse the existing Cacher: reroute any consumer that bypasses it
+            cacher = existing[0]
+        else:
+            graph, cacher = graph.add_node(Cacher(), [n])
+        for c in children:
+            if c == cacher:
+                continue
+            if isinstance(c, NodeId):
+                if _is_cacher(graph.get_operator(c)):
+                    continue  # a second cacher; leave it alone
+                deps = [
+                    cacher if d == n else d for d in graph.get_dependencies(c)
+                ]
+                graph = graph.set_dependencies(c, deps)
+            else:  # SinkId
+                graph = graph.set_sink_dependency(c, cacher)
+    return graph
+
+
+class AutoCacheRule(Rule):
+    """Insert Cacher nodes by the aggressive or greedy policy; the executor
+    then retains only cached results across pulls."""
+
+    def __init__(
+        self,
+        strategy: str = "greedy",
+        mem_budget_bytes: Optional[int] = None,
+        profiles: Optional[Dict[NodeId, Profile]] = None,
+    ):
         self.strategy = strategy
         self.mem_budget_bytes = mem_budget_bytes
+        self.profiles = profiles  # injectable for tests (parity: suite)
 
-    def apply(self, graph: Graph, annotations: Annotations) -> Tuple[Graph, Annotations]:
-        profiles = profile_nodes(graph)
+    def _select_aggressive(self, graph: Graph) -> set:
+        """Cache every node whose result is consumed along >1 downstream
+        path (parity: ``aggressiveCache``, AutoCacheRule.scala:503-518)."""
+        return {
+            n
+            for n in graph.nodes
+            if len(analysis.get_children(graph, n)) > 1
+            and not _is_cacher(graph.get_operator(n))
+        }
+
+    def _select_greedy(
+        self, graph: Graph, profiles: Dict[NodeId, Profile], budget: float
+    ) -> set:
         weights = {
             n: getattr(graph.get_operator(n), "weight", 1) for n in graph.nodes
         }
-        budget = self.mem_budget_bytes or (4 << 30)
-        cached: set = set()
+        # Existing Cacher nodes already cut recomputation: seed the run
+        # estimator with them so their upstreams' savings aren't double
+        # counted (parity: the reference seeds getRuns with cached nodes).
+        preexisting = {
+            n for n in graph.nodes if _is_cacher(graph.get_operator(n))
+        }
+        cached: set = set(preexisting)
+        spent = 0.0
+        while True:
+            runs = estimate_runs(graph, weights, cached)
+            best, best_save = None, 0.0
+            for n, p in profiles.items():
+                if n not in graph.nodes or n in cached:
+                    continue
+                if _is_cacher(graph.get_operator(n)):
+                    continue
+                if spent + p.mem_bytes > budget:
+                    continue
+                save = (runs[n] - 1) * p.ns
+                if save > best_save:
+                    best, best_save = n, save
+            if best is None:
+                break
+            cached.add(best)
+            spent += profiles[best].mem_bytes
+        return cached - preexisting
+
+    def apply(
+        self, graph: Graph, annotations: Annotations
+    ) -> Tuple[Graph, Annotations]:
         if self.strategy == "aggressive":
-            cached = {n for n in graph.nodes if len(analysis.get_children(graph, n)) > 1}
+            selected = self._select_aggressive(graph)
         else:
-            spent = 0.0
-            while True:
-                runs = estimate_runs(graph, weights, cached)
-                best, best_save = None, 0.0
-                for n, p in profiles.items():
-                    if n in cached or spent + p.mem_bytes > budget:
-                        continue
-                    save = (runs[n] - 1) * p.ns
-                    if save > best_save:
-                        best, best_save = n, save
-                if best is None:
-                    break
-                cached.add(best)
-                spent += profiles[best].mem_bytes
-        if cached:
-            logger.info(
-                "auto-cache: would retain %d nodes (%s)",
-                len(cached),
-                ", ".join(graph.get_operator(n).label for n in sorted(cached)),
+            profiles = self.profiles
+            if profiles is None:
+                profiles = profile_nodes(
+                    graph, full_size=_full_input_size(graph)
+                )
+            budget = (
+                self.mem_budget_bytes
+                if self.mem_budget_bytes is not None
+                else _device_budget_bytes()
             )
+            selected = self._select_greedy(graph, profiles, float(budget))
+        if selected:
+            logger.info(
+                "auto-cache (%s): inserting Cacher after %d nodes (%s)",
+                self.strategy,
+                len(selected),
+                ", ".join(
+                    graph.get_operator(n).label for n in sorted(selected)
+                ),
+            )
+            graph = insert_cachers(graph, sorted(selected))
+        annotations = dict(annotations)
+        annotations[AUTOCACHE_ACTIVE] = True  # type: ignore[index]
         return graph, annotations
+
+
+def _full_input_size(graph: Graph) -> int:
+    n = 1
+    for node in graph.nodes:
+        op = graph.get_operator(node)
+        if isinstance(op, DatasetOperator):
+            n = max(n, len(op.dataset))
+    return n

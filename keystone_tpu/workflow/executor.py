@@ -36,18 +36,56 @@ class GraphExecutor:
                 self._optimized = self._input_graph
         return self._optimized
 
+    def _retain(self, graph: Graph, graph_id: NodeId) -> bool:
+        """Whether this node's result stays resident across pulls.
+
+        Default: everything (the HBM-memoizing fast path). After the
+        AutoCacheRule has planned caching, only Cacher / estimator / source
+        dataset results are retained — other intermediates recompute per
+        pull, exactly like unpersisted RDDs in the reference, so the cache
+        budget genuinely bounds resident bytes."""
+        from .autocache import AUTOCACHE_ACTIVE
+
+        if not self._annotations.get(AUTOCACHE_ACTIVE):
+            return True
+        from ..nodes.util.core import Cacher
+        from .operators import (
+            DatasetOperator,
+            DatumOperator,
+            EstimatorOperator,
+            ExpressionOperator,
+        )
+
+        op = graph.get_operator(graph_id)
+        return isinstance(
+            op,
+            (Cacher, DatasetOperator, DatumOperator, EstimatorOperator,
+             ExpressionOperator),
+        )
+
     def execute(self, graph_id: GraphId) -> Expression:
+        return self._execute(graph_id, transient={})
+
+    def _execute(self, graph_id: GraphId, transient: Dict) -> Expression:
         graph = self.graph  # force optimization before anything runs
         if isinstance(graph_id, SourceId):
             raise ValueError(f"cannot execute unconnected {graph_id}")
         if isinstance(graph_id, SinkId):
-            return self.execute(graph.get_sink_dependency(graph_id))
+            return self._execute(graph.get_sink_dependency(graph_id), transient)
         if graph_id in self._state:
             return self._state[graph_id]
-        deps = [self.execute(d) for d in graph.get_dependencies(graph_id)]
+        if graph_id in transient:
+            return transient[graph_id]
+        deps = [
+            self._execute(d, transient) for d in graph.get_dependencies(graph_id)
+        ]
         op = graph.get_operator(graph_id)
         expr = op.execute(deps)
-        self._state[graph_id] = expr
+        if self._retain(graph, graph_id):
+            self._state[graph_id] = expr
+        else:
+            # shared within this pull (diamonds compute once), dropped after
+            transient[graph_id] = expr
         prefix = self._annotations.get(graph_id)
         if prefix is not None:
             PipelineEnv.get_or_create().state[prefix] = expr

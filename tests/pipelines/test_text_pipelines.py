@@ -146,3 +146,21 @@ def test_augmented_evaluator_average_and_borda():
     assert m.total_error == 0.0
     m2 = AugmentedExamplesEvaluator(names, 3, "borda").evaluate(preds, actuals)
     assert m2.total_error == 0.0
+
+
+def test_newsgroups_per_datum_apply():
+    """The fitted sparse chain must work per-item too (the reference's
+    SparseVector single-apply path)."""
+    from keystone_tpu.pipelines.newsgroups import (
+        NewsgroupsConfig,
+        build_predictor,
+        synthetic_newsgroups,
+    )
+
+    train = synthetic_newsgroups(128, num_classes=4, seed=9)
+    conf = NewsgroupsConfig(n_grams=2, common_features=800, num_classes=4)
+    predictor = build_predictor(train.data, train.labels, conf)
+    batch_preds = np.asarray(predictor(train.data).get().to_array())
+    doc = train.data.collect()[0]
+    datum_pred = int(np.asarray(predictor.apply_datum(doc).get()))
+    assert datum_pred == batch_preds[0]
