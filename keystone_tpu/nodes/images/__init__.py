@@ -5,6 +5,9 @@ from .lcs import LCSExtractor
 from .sift import SIFTExtractor
 from .core import (
     CenterCornerPatcher,
+    ImageExtractor,
+    LabelExtractor,
+    MultiLabelExtractor,
     Convolver,
     Cropper,
     GrayScaler,
@@ -28,6 +31,9 @@ __all__ = [
     "LCSExtractor",
     "SIFTExtractor",
     "CenterCornerPatcher",
+    "ImageExtractor",
+    "LabelExtractor",
+    "MultiLabelExtractor",
     "Convolver",
     "Cropper",
     "GrayScaler",

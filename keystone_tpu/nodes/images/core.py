@@ -372,3 +372,27 @@ class RandomImageTransformer(Transformer):
         flipped = jnp.flip(X, axis=2)
         mask = jnp.asarray(flips)[:, None, None, None]
         return Dataset(jnp.where(mask, flipped, X), batched=True)
+
+
+class LabelExtractor(Transformer):
+    """(label, image) item → label (parity: LabeledImageExtractors.scala:9-18).
+    Loaders here usually hand out LabeledData directly; these extractors keep
+    the reference's RDD[LabeledImage] composition style available."""
+
+    def apply(self, item):
+        return item[0]
+
+
+class ImageExtractor(Transformer):
+    """(label, image) item → image (parity: LabeledImageExtractors.scala:20-24)."""
+
+    def apply(self, item):
+        return item[1]
+
+
+class MultiLabelExtractor(Transformer):
+    """(label_set, image) item → label set
+    (parity: LabeledImageExtractors.scala:26-32)."""
+
+    def apply(self, item):
+        return item[0]

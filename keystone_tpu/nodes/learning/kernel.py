@@ -93,15 +93,35 @@ class KernelRidgeRegression(LabelEstimator):
 
     def __init__(self, gamma: float, lam: float, block_size: int,
                  num_epochs: int, block_permuter: Optional[int] = None,
-                 cache_kernel: bool = True):
+                 cache_kernel: bool = True,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_interval: int = 25):
         self.gamma = gamma
         self.lam = lam
         self.block_size = block_size
         self.num_epochs = num_epochs
         self.block_permuter = block_permuter
         self.cache_kernel = cache_kernel
+        # Solver-state checkpoint every N blocks — the TPU analogue of the
+        # reference's truncateLineage/RDD.checkpoint call
+        # (KernelRidgeRegression.scala:204-208, utils/MatrixUtils.scala:163-189):
+        # there it bounds RDD lineage depth; here the model has no lineage,
+        # so the surviving purpose is restart — a killed long fit resumes
+        # from the last saved (epoch, step, W).
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_interval = checkpoint_interval
+
+    def _ckpt_path(self) -> Optional[str]:
+        if not self.checkpoint_dir:
+            return None
+        import os
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        return os.path.join(self.checkpoint_dir, "krr_state.npz")
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
+        import os
+
         X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
         Y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
         n, k = Y.shape
@@ -115,11 +135,26 @@ class KernelRidgeRegression(LabelEstimator):
             if self.block_permuter is not None
             else None
         )
-        for _ in range(self.num_epochs):
+        start_epoch, start_step = 0, 0
+        ckpt = self._ckpt_path()
+        if ckpt and os.path.exists(ckpt):
+            saved = np.load(ckpt)
+            if saved["W"].shape == (n, k):
+                W = jnp.asarray(saved["W"])
+                start_epoch = int(saved["epoch"])
+                start_step = int(saved["step"])
+        steps_done = 0
+        for epoch in range(self.num_epochs):
+            # the permutation stream must be identical across a resume, so
+            # draw it per epoch regardless of where we restart
             order = list(range(num_blocks))
             if rng is not None:
                 rng.shuffle(order)
-            for blk in order:
+            if epoch < start_epoch:
+                continue
+            for step, blk in enumerate(order):
+                if epoch == start_epoch and step < start_step:
+                    continue
                 idxs = np.arange(blk * bs, min(n, (blk + 1) * bs))
                 jidx = jnp.asarray(idxs)
                 Kb = kernel.block(idxs)          # (n, b)
@@ -134,6 +169,16 @@ class KernelRidgeRegression(LabelEstimator):
                 W = W.at[jidx].set(W_new)
                 if not self.cache_kernel:
                     kernel.unpersist(idxs)
+                steps_done += 1
+                if ckpt and steps_done % self.checkpoint_interval == 0:
+                    np.savez(
+                        ckpt,
+                        W=np.asarray(jax.block_until_ready(W)),
+                        epoch=epoch,
+                        step=step + 1,
+                    )
+        if ckpt and os.path.exists(ckpt):
+            os.remove(ckpt)  # complete fit: drop the restart state
         return KernelBlockLinearMapper(X, W, self.gamma, bs)
 
 

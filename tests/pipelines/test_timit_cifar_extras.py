@@ -1,0 +1,170 @@
+"""TimitPipeline + remaining CIFAR apps (parity slices:
+TimitPipeline.scala, LinearPixels.scala, RandomCifar.scala,
+RandomPatchCifarAugmented.scala, RandomPatchCifarKernel.scala) and the
+KRR streaming/checkpoint mechanics the kernel app forces."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.loaders.cifar import synthetic_cifar
+from keystone_tpu.nodes.learning.kernel import (
+    BlockKernelMatrix,
+    KernelRidgeRegression,
+)
+from keystone_tpu.nodes.util import ClassLabelIndicators
+
+
+def test_timit_pipeline_synthetic():
+    from keystone_tpu.pipelines.timit import (
+        TimitConfig,
+        run,
+        synthetic_timit,
+    )
+
+    conf = TimitConfig(
+        num_cosines=3, num_epochs=2, lam=10.0, num_classes=12,
+        cosine_features=256, gamma=0.02,
+    )
+    train = synthetic_timit(768, conf.num_classes, seed=1)
+    test = synthetic_timit(256, conf.num_classes, seed=2)
+    _, evaluation, _ = run(train, test, conf)
+    # 12 Gaussian prototype classes: random errs ~92%
+    assert evaluation.total_error < 0.2, evaluation.summary()
+
+
+def test_timit_cauchy_branch_shapes():
+    from keystone_tpu.pipelines.timit import TimitConfig, build_featurizer
+
+    conf = TimitConfig(num_cosines=2, rf_type="cauchy",
+                       cosine_features=64, input_dim=20)
+    X = np.random.default_rng(0).standard_normal((8, 20)).astype(np.float32)
+    out = np.asarray(build_featurizer(conf)(X).get().to_array())
+    assert out.shape == (8, 2 * 64)
+
+
+def test_linear_pixels():
+    from keystone_tpu.pipelines.cifar_extras import run_linear_pixels
+
+    train = synthetic_cifar(512, seed=1)
+    test = synthetic_cifar(128, seed=2)
+    _, tr, te, _ = run_linear_pixels(train, test, lam=10.0)
+    assert te < 0.5  # grayscale pixels alone beat the 90% random error
+
+
+def test_random_cifar():
+    from keystone_tpu.pipelines.cifar_extras import run_random_cifar
+    from keystone_tpu.pipelines.random_patch_cifar import RandomCifarConfig
+
+    conf = RandomCifarConfig(num_filters=32, lam=10.0)
+    train = synthetic_cifar(256, seed=3)
+    test = synthetic_cifar(96, seed=4)
+    _, tr, te, _ = run_random_cifar(train, test, conf)
+    assert te < 0.6
+
+
+def test_random_patch_cifar_augmented():
+    from keystone_tpu.pipelines.cifar_extras import (
+        AugmentedCifarConfig,
+        run_random_patch_cifar_augmented,
+    )
+
+    conf = AugmentedCifarConfig(
+        num_filters=24, lam=50.0, whitener_size=3000,
+        num_random_images_augment=2, pool_size=8, pool_stride=7,
+    )
+    train = synthetic_cifar(192, seed=5)
+    test = synthetic_cifar(48, seed=6)
+    _, evaluation, _ = run_random_patch_cifar_augmented(train, test, conf)
+    assert evaluation.total_error < 0.6
+
+
+def test_random_patch_cifar_kernel_streaming():
+    from keystone_tpu.pipelines.cifar_extras import (
+        KernelCifarConfig,
+        run_random_patch_cifar_kernel,
+    )
+
+    conf = KernelCifarConfig(
+        num_filters=16, lam=1.0, gamma=1e-3, block_size=64,
+        num_epochs=1, cache_kernel=False, whitener_size=2000,
+        pool_size=8, pool_stride=7,
+    )
+    train = synthetic_cifar(192, seed=7)
+    test = synthetic_cifar(48, seed=8)
+    _, tr, te, _ = run_random_patch_cifar_kernel(train, test, conf)
+    assert te < 0.7
+
+
+# ---- KRR streaming + checkpoint mechanics --------------------------------
+
+def _krr_problem(n=180, d=10, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = rng.integers(0, k, size=n)
+    Y = np.asarray(
+        ClassLabelIndicators(k).apply_batch(Dataset.of(y)).to_array()
+    )
+    return X, Y
+
+
+def test_krr_cache_blocks_false_matches_cached():
+    X, Y = _krr_problem()
+    common = dict(gamma=0.1, lam=1.0, block_size=48, num_epochs=2,
+                  block_permuter=3)
+    m_cached = KernelRidgeRegression(cache_kernel=True, **common).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    m_stream = KernelRidgeRegression(cache_kernel=False, **common).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_cached.W), np.asarray(m_stream.W), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_krr_streaming_mode_frees_blocks():
+    X, Y = _krr_problem()
+    kernel = BlockKernelMatrix(X, 0.1, cache_blocks=False)
+    _ = kernel.block(np.arange(0, 48))
+    assert kernel._cache == {}
+    kernel_cached = BlockKernelMatrix(X, 0.1, cache_blocks=True)
+    _ = kernel_cached.block(np.arange(0, 48))
+    assert len(kernel_cached._cache) == 1
+    kernel_cached.unpersist(np.arange(0, 48))
+    assert kernel_cached._cache == {}
+
+
+def test_krr_checkpoint_resume(tmp_path, monkeypatch):
+    """A fit killed mid-solve resumes from the last checkpoint and lands on
+    the same model as an uninterrupted run (the truncateLineage-analogue
+    restart story, KernelRidgeRegression.scala:204-208)."""
+    X, Y = _krr_problem(n=200)
+    common = dict(gamma=0.1, lam=1.0, block_size=40, num_epochs=2,
+                  block_permuter=5)
+    ref = KernelRidgeRegression(**common).fit(Dataset.of(X), Dataset.of(Y))
+
+    est = KernelRidgeRegression(
+        checkpoint_dir=str(tmp_path), checkpoint_interval=1, **common
+    )
+    orig_block = BlockKernelMatrix.block
+    calls = {"n": 0}
+
+    def dying_block(self, idxs):
+        calls["n"] += 1
+        if calls["n"] > 4:
+            raise RuntimeError("simulated preemption")
+        return orig_block(self, idxs)
+
+    monkeypatch.setattr(BlockKernelMatrix, "block", dying_block)
+    with pytest.raises(RuntimeError):
+        est.fit(Dataset.of(X), Dataset.of(Y))
+    monkeypatch.setattr(BlockKernelMatrix, "block", orig_block)
+    assert (tmp_path / "krr_state.npz").exists()
+
+    resumed = est.fit(Dataset.of(X), Dataset.of(Y))
+    np.testing.assert_allclose(
+        np.asarray(resumed.W), np.asarray(ref.W), rtol=1e-4, atol=1e-5
+    )
+    # completed fit removes the restart state
+    assert not (tmp_path / "krr_state.npz").exists()
