@@ -49,7 +49,13 @@ def synthetic_cifar(n: int, seed: int = 0, num_classes: int = 10) -> LabeledData
     (class-specific spatial frequency + orientation), not absolute pixel
     levels — patch-normalized convolutional features deliberately discard
     means/contrast, so level-coded classes would be invisible to the
-    RandomPatchCifar featurizer."""
+    RandomPatchCifar featurizer.
+
+    A second, *position-fixed* low-frequency level pattern per class (shared
+    across channels, so it survives grayscale conversion) makes the classes
+    also visible to raw-pixel linear maps (LinearPixels); patch
+    normalization subtracts patch means, so it leaves the texture signal as
+    the dominant one for convolutional featurizers."""
     rng = np.random.default_rng(seed)
     xx, yy = np.meshgrid(np.arange(NROW), np.arange(NCOL), indexing="ij")
     protos = np.zeros((num_classes, NROW, NCOL, NCHAN), dtype=np.float32)
@@ -61,6 +67,11 @@ def synthetic_cifar(n: int, seed: int = 0, num_classes: int = 10) -> LabeledData
         )
         for c in range(NCHAN):
             protos[k, :, :, c] = 128 + 80 * np.cos(c * 1.1) * wave
+    # position-fixed smooth per-class level code (constant RNG: identical
+    # across differently-seeded train/test draws)
+    level_rng = np.random.default_rng(99)
+    coarse = level_rng.standard_normal((num_classes, 4, 4)).astype(np.float32)
+    levels = np.repeat(np.repeat(coarse, NROW // 4, axis=1), NCOL // 4, axis=2)
     y = rng.integers(0, num_classes, size=n).astype(np.int32)
     phase_x = rng.integers(0, NROW, size=n)
     phase_y = rng.integers(0, NCOL, size=n)
@@ -70,5 +81,6 @@ def synthetic_cifar(n: int, seed: int = 0, num_classes: int = 10) -> LabeledData
             for i in range(n)
         ]
     )
+    X = X + 30.0 * levels[y][..., None]
     X = X + 16.0 * rng.standard_normal(X.shape).astype(np.float32)
     return LabeledData(y, np.clip(X, 0, 255).astype(np.float32))

@@ -100,9 +100,18 @@ def run(train: LabeledData, test: LabeledData, conf: TimitConfig):
 
 def synthetic_timit(n: int, num_classes: int, dim: int = TIMIT_DIMENSION,
                     seed: int = 0) -> LabeledData:
-    """Gaussian class prototypes in the 440-dim MFCC-feature space."""
+    """Gaussian class prototypes in the 440-dim MFCC-feature space.
+
+    The prototypes come from a constant RNG so that differently-seeded draws
+    (train vs test) share the same class structure; only the sample noise
+    varies with ``seed``.
+    """
+    protos = (
+        np.random.default_rng(1234)
+        .standard_normal((num_classes, dim))
+        .astype(np.float32)
+    )
     rng = np.random.default_rng(seed)
-    protos = rng.standard_normal((num_classes, dim)).astype(np.float32)
     y = rng.integers(0, num_classes, size=n).astype(np.int32)
     X = protos[y] + 1.5 * rng.standard_normal((n, dim)).astype(np.float32)
     return LabeledData(y, X)
