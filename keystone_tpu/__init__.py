@@ -7,6 +7,36 @@ compile into a single fused XLA computation, solvers run on HBM-sharded arrays
 with ICI collectives, and featurizers are batched jax/Pallas kernels.
 """
 
+import os as _os
+
+
+def _enable_persistent_compile_cache() -> None:
+    """Point XLA at an on-disk compilation cache (set
+    ``KEYSTONE_NO_COMPILE_CACHE=1`` to disable, ``KEYSTONE_COMPILE_CACHE=dir``
+    to relocate). Compiles dominate cold-start wall time on TPU; caching them
+    across processes is free speed for every pipeline."""
+    if _os.environ.get("KEYSTONE_NO_COMPILE_CACHE"):
+        return
+    cache_dir = _os.environ.get("KEYSTONE_COMPILE_CACHE") or _os.path.join(
+        _os.path.expanduser("~"), ".cache", "keystone_tpu", "xla"
+    )
+    try:
+        import jax
+
+        if (
+            _os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or jax.config.jax_compilation_cache_dir
+        ):
+            return  # the user already configured a cache; don't hijack it
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - ancient jax without the knobs
+        pass
+
+
+_enable_persistent_compile_cache()
+
 from .data.dataset import Dataset
 from .workflow import (
     Chainable,

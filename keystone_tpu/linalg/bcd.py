@@ -19,7 +19,7 @@ Objective: min_W  Σ‖Σ_j A_j W_j − y‖² + λ Σ_j ‖W_j‖²  (one W_j p
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,35 +29,48 @@ from .row_matrix import solve_spd
 
 def _block_update_impl(
     Aj: jax.Array,
+    mj: jax.Array,
     Wj_old: jax.Array,
     pred: jax.Array,
     y: jax.Array,
     reg: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """One BCD block step. Returns (Wj_new, new_pred).
+    """One BCD block step on a raw (uncentered) block. Returns
+    (Wj_new, new_pred).
 
-    residual for block j:  r_j = y − pred + A_j W_j_old
-    W_j ← (A_jᵀA_j + λI)⁻¹ A_jᵀ r_j ; pred ← pred + A_j (W_j − W_j_old)
+    Centering (A_j − m_j) happens inside the program so XLA fuses the
+    subtract into the GEMM operand reads — the centered matrix is never
+    materialized in HBM.
+
+    residual for block j:  r_j = y − pred + Ã_j W_j_old
+    W_j ← (Ã_jᵀÃ_j + λI)⁻¹ Ã_jᵀ r_j ; pred ← pred + Ã_j (W_j − W_j_old)
     """
-    r = y - pred + Aj @ Wj_old
-    G = Aj.T @ Aj          # psum over data axis
-    c = Aj.T @ r           # psum over data axis
+    Ajc = Aj - mj
+    r = y - pred + Ajc @ Wj_old
+    G = Ajc.T @ Ajc        # psum over data axis
+    c = Ajc.T @ r          # psum over data axis
     Wj = solve_spd(G, c, reg)
-    pred = pred + Aj @ (Wj - Wj_old)
+    pred = pred + Ajc @ (Wj - Wj_old)
     return Wj, pred
 
 
 # Donate the prediction buffer on accelerators (in-place HBM update per
 # block). On the CPU backend donation intermittently aborts the process
 # (observed under the 8-device virtual mesh), so plain jit there.
-_block_update_donating = jax.jit(_block_update_impl, donate_argnums=(2,))
+_block_update_donating = jax.jit(_block_update_impl, donate_argnums=(3,))
 _block_update_plain = jax.jit(_block_update_impl)
 
 
-def _block_update(Aj, Wj_old, pred, y, reg):
+def _block_update(Aj, mj, Wj_old, pred, y, reg):
     if jax.default_backend() == "cpu":
-        return _block_update_plain(Aj, Wj_old, pred, y, reg)
-    return _block_update_donating(Aj, Wj_old, pred, y, reg)
+        return _block_update_plain(Aj, mj, Wj_old, pred, y, reg)
+    return _block_update_donating(Aj, mj, Wj_old, pred, y, reg)
+
+
+@jax.jit
+def _block_means(blocks, y):
+    """Column means of every block + labels in ONE program (one dispatch)."""
+    return [jnp.mean(b, axis=0) for b in blocks], jnp.mean(y, axis=0)
 
 
 def solve_blockwise_l2(
@@ -66,22 +79,34 @@ def solve_blockwise_l2(
     reg: float,
     num_iter: int = 1,
     dtype=jnp.float32,
+    means: Optional[Sequence[jax.Array]] = None,
 ) -> List[jax.Array]:
     """L2-regularised least squares over feature blocks by BCD.
 
     blocks: list of (n, b_j) row-sharded arrays (the VectorSplitter output);
     y: (n, k) row-sharded. ``num_iter=1`` is the reference's one-pass variant
-    (``solveOnePassL2``), used by MNIST/CIFAR/VOC. Returns per-block (b_j, k)
-    weights.
+    (``solveOnePassL2``), used by MNIST/CIFAR/VOC. ``means`` (per-block
+    column means) are subtracted inside the block program; pass them to get
+    centered solving without materializing centered copies. Returns
+    per-block (b_j, k) weights.
     """
+    from ..utils.timing import phase
+
     y = jnp.asarray(y, dtype=dtype)
     n, k = y.shape
     blocks = [jnp.asarray(b, dtype=dtype) for b in blocks]
+    if means is None:
+        means = [jnp.zeros((b.shape[1],), dtype=dtype) for b in blocks]
     Ws = [jnp.zeros((b.shape[1], k), dtype=dtype) for b in blocks]
     pred = jnp.zeros_like(y)
+    # Per-block phase logging (parity: KernelRidgeRegression.scala:216-224's
+    # per-block phase table). Gram/solve/update run as ONE compiled program
+    # per block shape, so one phase covers the device step.
     for _ in range(num_iter):
         for j, Aj in enumerate(blocks):
-            Ws[j], pred = _block_update(Aj, Ws[j], pred, y, reg)
+            with phase("bcd.block_update") as out:
+                Ws[j], pred = _block_update(Aj, means[j], Ws[j], pred, y, reg)
+                out.append(pred)
     return Ws
 
 

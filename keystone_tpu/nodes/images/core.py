@@ -30,6 +30,7 @@ import numpy as np
 
 from ...data.dataset import Dataset
 from ...workflow.transformer import Transformer
+from ...utils.params import as_param
 
 _DIMNUMS = ("NHWC", "HWIO", "NHWC")  # H≡x, W≡y throughout
 
@@ -123,7 +124,7 @@ class Convolver(Transformer):
         normalize_patches: bool = True,
         var_constant: float = 10.0,
     ):
-        self.filters = jnp.asarray(filters, dtype=jnp.float32)
+        self.filters = as_param(filters, dtype='float32')
         self.img_x = img_x
         self.img_y = img_y
         self.img_channels = img_channels

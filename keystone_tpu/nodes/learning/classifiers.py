@@ -19,6 +19,7 @@ from ...data.dataset import Dataset
 from ...parallel.mesh import default_mesh, shard_batch
 from ...workflow.node_optimization import Optimizable
 from ...workflow.transformer import LabelEstimator, Transformer
+from ...utils.params import as_param
 from .cost import CostModel
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, minimize_lbfgs
 from .linear import BlockLeastSquaresEstimator, LinearMapEstimator, LinearMapper
@@ -29,8 +30,8 @@ class NaiveBayesModel(Transformer):
     NaiveBayesModel.scala:21-60: pi + theta·x, both already logs)."""
 
     def __init__(self, pi, theta):
-        self.pi = jnp.asarray(pi)          # (k,) log priors
-        self.theta = jnp.asarray(theta)    # (k, d) log feature probs
+        self.pi = as_param(pi)          # (k,) log priors
+        self.theta = as_param(theta)    # (k, d) log feature probs
 
     def trace_batch(self, X):
         return X @ self.theta.T + self.pi
@@ -117,7 +118,7 @@ class LogisticRegressionModel(Transformer):
     LogisticRegressionModel.scala:19-40, which emits the predicted class)."""
 
     def __init__(self, W):
-        self.W = jnp.asarray(W)
+        self.W = as_param(W)
 
     def trace_batch(self, X):
         return jnp.argmax(X @ self.W, axis=-1)

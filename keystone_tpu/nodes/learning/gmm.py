@@ -22,6 +22,7 @@ import numpy as np
 
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
+from ...utils.params import as_param
 from .kmeans import KMeansPlusPlusEstimator
 
 KMEANS_PLUS_PLUS_INITIALIZATION = "kmeans++"
@@ -97,9 +98,9 @@ class GaussianMixtureModel(Transformer):
 
     def __init__(self, means, variances, weights,
                  weight_threshold: float = 1e-4):
-        self.means = jnp.asarray(means)
-        self.variances = jnp.asarray(variances)
-        self.weights = jnp.asarray(weights)
+        self.means = as_param(means)
+        self.variances = as_param(variances)
+        self.weights = as_param(weights)
         self.weight_threshold = weight_threshold
         self.k = self.means.shape[1]
         self.dim = self.means.shape[0]

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...data.dataset import Dataset
+from ...utils.timing import phase
 from ...workflow.transformer import LabelEstimator, Transformer
 
 
@@ -67,6 +68,12 @@ class BlockKernelMatrix:
 class KernelBlockLinearMapper(Transformer):
     """Apply a kernel model: out = Σ_B K(test, train_B) · W_B
     (parity: KernelBlockLinearMapper.scala:28-90)."""
+
+    # Never trace-fuse: train_X/W are dataset-sized, so baking them into a
+    # fused XLA module as literals (or fetching them host-side) is exactly
+    # the wrong trade. They stay device-resident; _gaussian_block takes them
+    # as jit *arguments*.
+    no_fuse = True
 
     def __init__(self, train_X, model_W, gamma: float, block_size: int):
         self.train_X = jnp.asarray(train_X, dtype=jnp.float32)
@@ -157,16 +164,28 @@ class KernelRidgeRegression(LabelEstimator):
                     continue
                 idxs = np.arange(blk * bs, min(n, (blk + 1) * bs))
                 jidx = jnp.asarray(idxs)
-                Kb = kernel.block(idxs)          # (n, b)
-                Kbb = kernel.diag_block(idxs)    # (b, b)
-                W_old = W[jidx]                  # (b, k)
-                residual = Kb.T @ W - Kbb.T @ W_old
-                rhs = Y[jidx] - residual
-                lhs = Kbb + self.lam * jnp.eye(
-                    Kbb.shape[0], dtype=Kbb.dtype
-                )
-                W_new = jnp.linalg.solve(lhs, rhs)
-                W = W.at[jidx].set(W_new)
+                # per-block phase table (parity: the reference's
+                # kernelGen/residual/localSolve/modelUpdate timing logs,
+                # KernelRidgeRegression.scala:216-224); sync only under
+                # KEYSTONE_PROFILE — the default path stays async
+                with phase("krr.kernel_gen") as out:
+                    Kb = kernel.block(idxs)          # (n, b)
+                    Kbb = kernel.diag_block(idxs)    # (b, b)
+                    out.append(Kbb)
+                with phase("krr.residual") as out:
+                    W_old = W[jidx]                  # (b, k)
+                    residual = Kb.T @ W - Kbb.T @ W_old
+                    rhs = Y[jidx] - residual
+                    out.append(rhs)
+                with phase("krr.local_solve") as out:
+                    lhs = Kbb + self.lam * jnp.eye(
+                        Kbb.shape[0], dtype=Kbb.dtype
+                    )
+                    W_new = jnp.linalg.solve(lhs, rhs)
+                    out.append(W_new)
+                with phase("krr.model_update") as out:
+                    W = W.at[jidx].set(W_new)
+                    out.append(W)
                 if not self.cache_kernel:
                     kernel.unpersist(idxs)
                 steps_done += 1

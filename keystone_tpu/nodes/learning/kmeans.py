@@ -14,6 +14,7 @@ import numpy as np
 
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
+from ...utils.params import as_param
 
 
 @jax.jit
@@ -37,7 +38,7 @@ class KMeansModel(Transformer):
     (parity: KMeansModel, KMeansPlusPlus.scala:16-78)."""
 
     def __init__(self, means):
-        self.means = jnp.asarray(means)
+        self.means = as_param(means)
 
     def trace_batch(self, X):
         return _one_hot_assign(X, self.means)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ...data.dataset import Dataset
 from ...linalg import solve_blockwise_l2, solve_least_squares
 from ...parallel.mesh import shard_batch
+from ...utils.params import as_param
 from ...workflow.transformer import LabelEstimator, Transformer
 from .cost import CostModel
 
@@ -34,11 +35,9 @@ class LinearMapper(Transformer):
     scaling folded into the single GEMM)."""
 
     def __init__(self, W, b=None, feature_mean=None):
-        self.W = jnp.asarray(W)
-        self.b = None if b is None else jnp.asarray(b)
-        self.feature_mean = (
-            None if feature_mean is None else jnp.asarray(feature_mean)
-        )
+        self.W = as_param(W)
+        self.b = as_param(b)
+        self.feature_mean = as_param(feature_mean)
 
     def trace_batch(self, X):
         if self.feature_mean is not None:
@@ -82,19 +81,23 @@ class BlockLinearMapper(Transformer):
 
     def __init__(self, xs: Sequence, block_size: int, b=None,
                  feature_means: Optional[Sequence] = None):
-        self.xs = [jnp.asarray(x) for x in xs]
+        import numpy as np
+
+        # One batched device fetch; parameters live on host (utils/params.py)
+        xs, b, feature_means = jax.device_get((list(xs), b, feature_means))
+        self.xs = [as_param(x) for x in xs]
         self.block_size = block_size
-        self.b = None if b is None else jnp.asarray(b)
+        self.b = as_param(b)
         self.feature_means = (
             None
             if feature_means is None
-            else [jnp.asarray(m) for m in feature_means]
+            else [as_param(m) for m in feature_means]
         )
-        self._W = jnp.concatenate(self.xs, axis=0)
+        self._W = np.concatenate(self.xs, axis=0)
         self._mean = (
             None
             if self.feature_means is None
-            else jnp.concatenate(self.feature_means, axis=0)
+            else np.concatenate(self.feature_means, axis=0)
         )
 
     def trace_batch(self, X):
@@ -153,14 +156,23 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
             ]
         y = Dataset.of(labels).to_array().astype(jnp.float32)
 
-        y_mean = jnp.mean(y, axis=0)
-        blocks = [shard_batch(b.astype(jnp.float32)) for b in blocks]
-        means = [jnp.mean(b, axis=0) for b in blocks]
-        centered = [b - m for b, m in zip(blocks, means)]
-        ws = solve_blockwise_l2(
-            centered, shard_batch(y - y_mean), reg=self.lam,
-            num_iter=self.num_iter,
-        )
+        from ...linalg.bcd import _block_means
+        from ...utils.timing import phase
+
+        with phase("block_ls.center") as out:
+            blocks = [
+                shard_batch(b if b.dtype == jnp.float32 else b.astype(jnp.float32))
+                for b in blocks
+            ]
+            # one program for every mean; centering itself is fused into the
+            # per-block solve so centered copies never hit HBM
+            means, y_mean = _block_means(blocks, y)
+            out.append(y_mean)
+        with phase("block_ls.solve"):
+            ws = solve_blockwise_l2(
+                blocks, shard_batch(y - y_mean), reg=self.lam,
+                num_iter=self.num_iter, means=means,
+            )
         return BlockLinearMapper(
             ws, self.block_size, b=y_mean, feature_means=means
         )
@@ -188,8 +200,8 @@ class SparseLinearMapper(Transformer):
     """
 
     def __init__(self, W, b=None):
-        self.W = jnp.asarray(W)
-        self.b = None if b is None else jnp.asarray(b)
+        self.W = as_param(W)
+        self.b = as_param(b)
 
     def apply_batch(self, data):
         from ...data.sparse import SparseRows

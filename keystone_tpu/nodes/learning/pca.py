@@ -24,6 +24,7 @@ from ...linalg.tsqr import tsqr_r
 from ...parallel.mesh import default_mesh
 from ...workflow.node_optimization import Optimizable
 from ...workflow.transformer import Estimator, Transformer
+from ...utils.params import as_param
 from .cost import (
     CostModel,
     DEFAULT_CPU_WEIGHT,
@@ -46,7 +47,7 @@ class PCATransformer(Transformer):
     ``pca_mat`` is (d, dims)."""
 
     def __init__(self, pca_mat):
-        self.pca_mat = jnp.asarray(pca_mat)
+        self.pca_mat = as_param(pca_mat)
 
     def trace_batch(self, X):
         return X @ self.pca_mat
@@ -57,7 +58,7 @@ class BatchPCATransformer(Transformer):
     (parity: BatchPCATransformer, PCA.scala:38-44)."""
 
     def __init__(self, pca_mat):
-        self.pca_mat = jnp.asarray(pca_mat)
+        self.pca_mat = as_param(pca_mat)
 
     def trace_batch(self, X):
         # X: (n, d, n_desc) → (n, dims, n_desc)

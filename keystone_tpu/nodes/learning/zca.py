@@ -13,14 +13,15 @@ import jax.numpy as jnp
 
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
+from ...utils.params import as_param
 
 
 class ZCAWhitener(Transformer):
     """x → (x − means) · W (parity: ZCAWhitener.scala:12-18)."""
 
     def __init__(self, whitener, means):
-        self.whitener = jnp.asarray(whitener)
-        self.means = jnp.asarray(means)
+        self.whitener = as_param(whitener)
+        self.means = as_param(means)
 
     def trace_batch(self, X):
         return (X - self.means) @ self.whitener

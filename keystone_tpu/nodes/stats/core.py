@@ -22,6 +22,7 @@ import numpy as np
 
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
+from ...utils.params import as_param
 
 
 class PaddedFFT(Transformer):
@@ -43,7 +44,7 @@ class RandomSignNode(Transformer):
     (parity: RandomSignNode.scala:11,19-24)."""
 
     def __init__(self, signs):
-        self.signs = jnp.asarray(signs)
+        self.signs = as_param(signs)
 
     @staticmethod
     def create(size: int, seed: int = 0) -> "RandomSignNode":
@@ -109,8 +110,8 @@ class CosineRandomFeatures(Transformer):
     """
 
     def __init__(self, W, b):
-        self.W = jnp.asarray(W)
-        self.b = jnp.asarray(b)
+        self.W = as_param(W)
+        self.b = as_param(b)
         if self.b.shape[0] != self.W.shape[0]:
             raise ValueError("rows of W and size of b must match")
 
@@ -147,8 +148,8 @@ class StandardScalerModel(Transformer):
     (parity: StandardScaler.scala:16-32)."""
 
     def __init__(self, mean, std=None):
-        self.mean = jnp.asarray(mean)
-        self.std = None if std is None else jnp.asarray(std)
+        self.mean = as_param(mean)
+        self.std = as_param(std)
 
     def trace_batch(self, X):
         out = X - self.mean

@@ -26,6 +26,12 @@ class DefaultOptimizer(Optimizer):
     def batches(self) -> List[Batch]:
         from .node_optimization import NodeOptimizationRule
 
+        return self._head_batches() + [
+            Batch("Node Level Optimization", Strategy.ONCE, [NodeOptimizationRule()]),
+            self._fusion_batch(),
+        ]
+
+    def _head_batches(self) -> List[Batch]:
         return [
             Batch(
                 "Load Saved State",
@@ -37,8 +43,15 @@ class DefaultOptimizer(Optimizer):
                 Strategy.FIXED_POINT,
                 [EquivalentNodeMergeRule()],
             ),
-            Batch("Node Level Optimization", Strategy.ONCE, [NodeOptimizationRule()]),
         ]
+
+    def _fusion_batch(self) -> Batch:
+        """Last batch always: collapse traceable chains into single jitted
+        operators (one XLA program instead of N eager dispatches). Runs after
+        every structural rule so Cachers/estimators bound the fusion groups."""
+        from .fusion import TraceFusionRule
+
+        return Batch("Trace Fusion", Strategy.ONCE, [TraceFusionRule()])
 
 
 class AutoCachingOptimizer(DefaultOptimizer):
@@ -51,11 +64,14 @@ class AutoCachingOptimizer(DefaultOptimizer):
 
     def batches(self) -> List[Batch]:
         from .autocache import AutoCacheRule
+        from .node_optimization import NodeOptimizationRule
 
-        return super().batches() + [
+        return self._head_batches() + [
+            Batch("Node Level Optimization", Strategy.ONCE, [NodeOptimizationRule()]),
             Batch(
                 "Auto Cache",
                 Strategy.ONCE,
                 [AutoCacheRule(self.strategy, self.mem_budget_bytes)],
-            )
+            ),
+            self._fusion_batch(),
         ]

@@ -337,18 +337,27 @@ class FittedPipeline(Chainable):
     def to_pipeline(self) -> Pipeline:
         return Pipeline(self._graph, self._source, self._sink)
 
-    # -- application (no optimizer pass: parity with reference, which applies
-    #    FittedPipelines without re-optimizing) --------------------------
+    # -- application (no full optimizer pass: parity with reference, which
+    #    applies FittedPipelines without re-optimizing; the one TPU-side
+    #    exception is trace fusion, which rewrites the transformer chain into
+    #    jitted blocks whose compiled executables persist across apply calls)
+
+    def _fused_graph(self) -> Graph:
+        if getattr(self, "_fused", None) is None:
+            from .fusion import TraceFusionRule
+
+            self._fused, _ = TraceFusionRule().apply(self._graph, {})
+        return self._fused
 
     def apply(self, data: Any) -> Dataset:
-        graph, data_id = attach_data(self._graph, data)
+        graph, data_id = attach_data(self._fused_graph(), data)
         graph = graph.replace_dependency(self._source, data_id)
         graph = graph.remove_source(self._source)
         executor = GraphExecutor(graph, optimize=False)
         return executor.execute(self._sink).get()
 
     def apply_datum(self, datum: Any) -> Any:
-        graph, datum_id = attach_datum(self._graph, datum)
+        graph, datum_id = attach_datum(self._fused_graph(), datum)
         graph = graph.replace_dependency(self._source, datum_id)
         graph = graph.remove_source(self._source)
         executor = GraphExecutor(graph, optimize=False)

@@ -1,0 +1,189 @@
+"""TraceFusionRule grouping invariants + FusedTransformerOperator semantics.
+
+The rule rewrites every fit/apply execution path, so its constraints get
+direct coverage: multi-consumer exclusion, sink-consumed exclusion, Cacher
+(untraceable) boundaries, annotated-node exclusion, external-dep splicing,
+and the non-batched Dataset fallback.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.workflow.fusion import FusedTransformerOperator, TraceFusionRule
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator
+from keystone_tpu.workflow.pipeline import Pipeline
+from keystone_tpu.workflow.transformer import FunctionNode, Transformer
+
+
+class _Mul(Transformer):
+    def __init__(self, k):
+        self.k = k
+
+    def trace_batch(self, X):
+        return X * self.k
+
+
+class _HostOnly(Transformer):
+    """No trace_batch — a fusion boundary, like Cacher/Shuffler."""
+
+    def apply(self, x):
+        return x + 1.0
+
+
+def _fused_ops(graph):
+    return [
+        graph.get_operator(n)
+        for n in graph.nodes
+        if isinstance(graph.get_operator(n), FusedTransformerOperator)
+    ]
+
+
+def test_linear_chain_fuses_to_one_node_with_same_output():
+    pipe = _Mul(2.0).and_then(_Mul(3.0)).and_then(_Mul(0.5))
+    fused_graph, _ = TraceFusionRule().apply(pipe.graph, {})
+    ops = _fused_ops(fused_graph)
+    assert len(ops) == 1 and len(ops[0].steps) == 3
+    # remaining node count: just the fused node
+    assert len(fused_graph.nodes) == 1
+
+    X = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = Pipeline(fused_graph, pipe.source, pipe.sink)(X).get().to_array()
+    np.testing.assert_allclose(np.asarray(out), X * 3.0)
+
+
+def test_host_node_bounds_groups():
+    pipe = _Mul(2.0).and_then(_Mul(3.0)).and_then(_HostOnly()).and_then(_Mul(4.0))
+    fused_graph, _ = TraceFusionRule().apply(pipe.graph, {})
+    ops = _fused_ops(fused_graph)
+    # upstream pair fuses; the single node after the host boundary stays bare
+    assert len(ops) == 1 and len(ops[0].steps) == 2
+    X = np.ones((2, 2), dtype=np.float32)
+    out = Pipeline(fused_graph, pipe.source, pipe.sink)(X).get().to_array()
+    np.testing.assert_allclose(np.asarray(out), (X * 6.0 + 1.0) * 4.0)
+
+
+def test_diamond_with_all_consumers_traceable_fuses_whole():
+    # shared feeds two traceable branches re-joined by gather: the whole
+    # diamond is ONE legal group (every consumer of every member is inside)
+    shared = _Mul(2.0)
+    b1 = shared.and_then(_Mul(3.0)).and_then(_Mul(5.0))
+    b2 = shared.and_then(_Mul(7.0)).and_then(_Mul(11.0))
+    pipe = Pipeline.gather([b1, b2])
+    from keystone_tpu.workflow.rules import EquivalentNodeMergeRule
+
+    graph, _ = EquivalentNodeMergeRule().apply(pipe.graph, {})
+    fused_graph, _ = TraceFusionRule().apply(graph, {})
+    assert len(_fused_ops(fused_graph)) == 1
+    X = np.ones((2, 2), dtype=np.float32)
+    out = Pipeline(fused_graph, pipe.source, pipe.sink)(X).get()
+    got = [np.asarray(a) for a in out.payload]
+    np.testing.assert_allclose(got[0], X * 30.0)
+    np.testing.assert_allclose(got[1], X * 154.0)
+
+
+def test_node_with_consumer_outside_group_not_absorbed():
+    # shared feeds a traceable chain AND a host-only node: the group built
+    # around the chain cannot absorb shared (host consumer is outside it)
+    shared = _Mul(2.0)
+    b1 = shared.and_then(_Mul(3.0)).and_then(_Mul(5.0))
+    b2 = shared.and_then(_HostOnly())
+    pipe = Pipeline.gather([b1, b2])
+    from keystone_tpu.workflow.rules import EquivalentNodeMergeRule
+
+    graph, _ = EquivalentNodeMergeRule().apply(pipe.graph, {})
+    fused_graph, _ = TraceFusionRule().apply(graph, {})
+    for op in _fused_ops(fused_graph):
+        assert shared not in [s[0] for s in op.steps], (
+            "node with an out-of-group consumer was absorbed"
+        )
+    X = np.ones((2, 2), dtype=np.float32)
+    out = Pipeline(fused_graph, pipe.source, pipe.sink)(X).get()
+    got = [np.asarray(a) for a in out.payload]
+    np.testing.assert_allclose(got[0], X * 30.0)
+    np.testing.assert_allclose(got[1], X * 2.0 + 1.0)
+
+
+def test_sink_consumed_interior_node_not_absorbed():
+    # graph with two sinks: one at the chain end, one at an interior node
+    a, b = _Mul(2.0), _Mul(3.0)
+    graph = Graph()
+    graph, source = graph.add_source()
+    graph, na = graph.add_node(a, [source])
+    graph, nb = graph.add_node(b, [na])
+    graph, sink_mid = graph.add_sink(na)
+    graph, sink_end = graph.add_sink(nb)
+    fused_graph, _ = TraceFusionRule().apply(graph, {})
+    # na is sink-consumed: no group may absorb it, so nothing fuses (groups
+    # of one are left alone)
+    assert _fused_ops(fused_graph) == []
+
+
+def test_annotated_node_not_fused():
+    pipe = _Mul(2.0).and_then(_Mul(3.0))
+    # annotate the first node (as if it were a saveable prefix)
+    first = sorted(pipe.graph.nodes)[0]
+    fused_graph, ann = TraceFusionRule().apply(pipe.graph, {first: "prefix"})
+    assert _fused_ops(fused_graph) == []
+    assert ann == {first: "prefix"}
+
+
+def test_item_dataset_fallback_matches_batched():
+    pipe = _Mul(2.0).and_then(_Mul(3.0))
+    fused_graph, _ = TraceFusionRule().apply(pipe.graph, {})
+    (fused,) = _fused_ops(fused_graph)
+    ragged = Dataset.from_items(
+        [np.ones((2,), np.float32), np.zeros((3,), np.float32)]
+    )
+    from keystone_tpu.workflow.expressions import DatasetExpression
+
+    out = fused.batch_transform([DatasetExpression.now(ragged)])
+    got = out.collect()
+    np.testing.assert_allclose(np.asarray(got[0]), np.full((2,), 6.0))
+    np.testing.assert_allclose(np.asarray(got[1]), np.zeros((3,)))
+
+
+def test_fused_single_datum_path():
+    pipe = _Mul(2.0).and_then(_Mul(3.0))
+    fused_graph, _ = TraceFusionRule().apply(pipe.graph, {})
+    p = Pipeline(fused_graph, pipe.source, pipe.sink)
+    out = p.apply_datum(np.ones((3,), np.float32)).get()
+    np.testing.assert_allclose(np.asarray(out), np.full((3,), 6.0))
+
+
+def test_gather_and_combiner_fuse_and_agree():
+    from keystone_tpu.nodes.util import VectorCombiner
+
+    branches = [_Mul(float(i + 1)) for i in range(3)]
+    pipe = Pipeline.gather(branches).and_then(VectorCombiner())
+    fused_graph, _ = TraceFusionRule().apply(pipe.graph, {})
+    ops = _fused_ops(fused_graph)
+    assert len(ops) == 1 and len(ops[0].steps) == 5  # 3 muls + gather + combiner
+    X = np.ones((2, 2), dtype=np.float32)
+    out = Pipeline(fused_graph, pipe.source, pipe.sink)(X).get().to_array()
+    expect = np.concatenate([X * 1, X * 2, X * 3], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_fusion_idempotent_and_picklable():
+    import pickle
+
+    pipe = _Mul(2.0).and_then(_Mul(3.0))
+    g1, _ = TraceFusionRule().apply(pipe.graph, {})
+    g2, _ = TraceFusionRule().apply(g1, {})
+    assert len(_fused_ops(g2)) == 1
+    (fused,) = _fused_ops(g1)
+    fused._jitted()  # populate the non-picklable cache
+    clone = pickle.loads(pickle.dumps(fused))
+    assert clone._jit is None and len(clone.steps) == len(fused.steps)
+
+
+def test_no_fuse_marker_respected():
+    marked = _Mul(3.0)
+    marked.no_fuse = True
+    pipe = _Mul(2.0).and_then(marked).and_then(_Mul(4.0))
+    fused_graph, _ = TraceFusionRule().apply(pipe.graph, {})
+    for op in _fused_ops(fused_graph):
+        assert marked not in [s[0] for s in op.steps]
