@@ -195,12 +195,14 @@ def bench_mnist() -> dict:
     # the MFU. Steady MFU from dedicated solve reps with forced completion
     # (min of 5), e2e MFU against the whole best fit.
     n = int(Xtr.shape[0])
-    d = int(build_featurizer(conf)(Xte[:2]).get().to_array().shape[-1])
-    n_blocks = -(-d // conf.block_size)
-    solve_flops = 2.0 * n * d * min(conf.block_size, d) + n_blocks * (
-        min(conf.block_size, d) ** 3
-    ) / 3.0
     F = build_featurizer(conf)(Xtr).get().to_array()
+    d = int(F.shape[-1])
+    bs = min(conf.block_size, d)
+    n_blocks = -(-d // conf.block_size)
+    solve_flops = 2.0 * n * d * bs + n_blocks * (bs**3) / 3.0
+    # time EXACTLY the partitioning the flop model describes: block_size-wide
+    # column blocks, like the fit path
+    F_blocks = [F[:, i : i + conf.block_size] for i in range(0, d, conf.block_size)]
     y = jax.device_put(
         np.asarray(labels.to_array(), dtype=np.float32)
     )
@@ -209,7 +211,9 @@ def bench_mnist() -> dict:
         # vary reg by epsilon so a memoizing device transport cannot return
         # a cached result; reg is a traced scalar, so no recompiles
         t0 = time.perf_counter()
-        Ws = solve_blockwise_l2([F], y, reg=conf.lam * (1.0 + (i + 1) * 1e-7))
+        Ws = solve_blockwise_l2(
+            F_blocks, y, reg=conf.lam * (1.0 + (i + 1) * 1e-7)
+        )
         _fetch_scalar(Ws[0])
         solve_times.append(time.perf_counter() - t0 - fetch_latency)
     t_solve_steady = max(min(solve_times), 1e-9)

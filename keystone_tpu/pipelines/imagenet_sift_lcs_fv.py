@@ -258,6 +258,16 @@ def synthetic_imagenet(n: int, num_classes: int, size: int = 64, seed: int = 0):
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("ImageNetSiftLcsFV")
+    # tar-of-JPEG ingestion (parity: ImageNetSiftLcsFV.scala:146-204's
+    # trainLocation/testLocation/labelPath); --imageSize is the explicit
+    # ragged-size policy: every image is resized to one canonical square
+    # so the two featurizer branches compile to fixed-shape programs
+    p.add_argument("--trainLocation", default=None,
+                   help="tar file or dir of tars of class-dir JPEGs")
+    p.add_argument("--testLocation", default=None)
+    p.add_argument("--labelsFile", default=None,
+                   help="'<classdir> <int>' lines (ImageNetLoader format)")
+    p.add_argument("--imageSize", type=int, default=256)
     p.add_argument("--lambda", dest="lam", type=float, default=6e-5)
     p.add_argument("--mixtureWeight", type=float, default=0.25)
     p.add_argument("--descDim", type=int, default=64)
@@ -297,8 +307,27 @@ def main(argv=None) -> int:
         lcs_gmm_var_file=args.lcsGmmVarFile,
         lcs_gmm_wts_file=args.lcsGmmWtsFile,
     )
-    tr_i, tr_l = synthetic_imagenet(args.nTrain, conf.num_classes, seed=1)
-    te_i, te_l = synthetic_imagenet(args.nTest, conf.num_classes, seed=2)
+    if args.trainLocation:
+        from ..loaders.images import load_imagenet, read_labels_map
+
+        # labels with id >= num_classes would one_hot to all-zero indicator
+        # rows and silently poison the solve — size the label space from
+        # the labels file itself
+        max_label = max(read_labels_map(args.labelsFile).values())
+        if max_label >= conf.num_classes:
+            conf.num_classes = max_label + 1
+        size = (args.imageSize, args.imageSize)
+        train = load_imagenet(args.trainLocation, args.labelsFile, size=size)
+        test = load_imagenet(
+            args.testLocation or args.trainLocation, args.labelsFile, size=size
+        )
+        tr_i = np.asarray(train.data.to_array())
+        tr_l = train.labels
+        te_i = np.asarray(test.data.to_array())
+        te_l = test.labels
+    else:
+        tr_i, tr_l = synthetic_imagenet(args.nTrain, conf.num_classes, seed=1)
+        te_i, te_l = synthetic_imagenet(args.nTest, conf.num_classes, seed=2)
     _, err, seconds = run(tr_i, tr_l, te_i, te_l, conf)
     print(f"TEST Error is {err}%")
     print(f"Pipeline took {seconds} s")

@@ -162,6 +162,16 @@ def synthetic_voc(n: int, size: int = 64, seed: int = 0):
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("VOCSIFTFisher")
+    # tar-of-JPEG ingestion (parity: VOCSIFTFisher.scala's trainLocation/
+    # testLocation/labelPath); --imageSize is the explicit ragged-size
+    # policy — one canonical square so the featurizer is one program
+    p.add_argument("--trainLocation", default=None,
+                   help="VOC image tar (or dir of tars)")
+    p.add_argument("--testLocation", default=None)
+    p.add_argument("--labelPath", default=None, help="VOC labels CSV")
+    p.add_argument("--testLabelPath", default=None)
+    p.add_argument("--namePrefix", default="VOCdevkit/VOC2007/JPEGImages/")
+    p.add_argument("--imageSize", type=int, default=256)
     p.add_argument("--vocabSize", type=int, default=16)
     p.add_argument("--descDim", type=int, default=24)
     p.add_argument("--lambda", dest="lam", type=float, default=0.5)
@@ -187,8 +197,22 @@ def main(argv=None) -> int:
         gmm_var_file=args.gmmVarFile,
         gmm_wts_file=args.gmmWtsFile,
     )
-    tr_imgs, tr_labels = synthetic_voc(args.nTrain, seed=1)
-    te_imgs, te_labels = synthetic_voc(args.nTest, seed=2)
+    if args.trainLocation:
+        from ..loaders.images import load_voc
+
+        size = (args.imageSize, args.imageSize)
+        train = load_voc(args.trainLocation, args.labelPath,
+                         name_prefix=args.namePrefix, size=size)
+        test = load_voc(args.testLocation or args.trainLocation,
+                        args.testLabelPath or args.labelPath,
+                        name_prefix=args.namePrefix, size=size)
+        tr_imgs = np.asarray(train.data.to_array())
+        tr_labels = train.labels
+        te_imgs = np.asarray(test.data.to_array())
+        te_labels = test.labels
+    else:
+        tr_imgs, tr_labels = synthetic_voc(args.nTrain, seed=1)
+        te_imgs, te_labels = synthetic_voc(args.nTest, seed=2)
     aps, seconds = run(tr_imgs, tr_labels, te_imgs, te_labels, conf)
     for i, ap in enumerate(aps):
         print(f"Class {i} avg precision: {ap}")

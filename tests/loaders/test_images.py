@@ -1,0 +1,141 @@
+"""Image ingestion tests.
+
+Two layers, matching the reference's two fixture sources:
+ * self-generated tar-of-JPEGs fixtures (PIL-encoded in-test), covering the
+   decode rules, size policies, and label mapping;
+ * the reference checkout's real fixture tars when mounted — the same
+   oracle assertions as VOCLoaderSuite.scala / ImageNetLoaderSuite.
+"""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.images import (
+    MIN_DIM,
+    decode_image_bytes,
+    iter_tar_images,
+    load_imagenet,
+    load_voc,
+)
+
+REF = "/root/reference/src/test/resources/images"
+
+
+def _jpeg_bytes(arr: np.ndarray) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr.astype(np.uint8)).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _make_tar(path, entries):
+    """entries: {name: bytes}"""
+    with tarfile.open(path, "w") as tf:
+        for name, data in entries.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def image_tar(tmp_path):
+    rng = np.random.default_rng(0)
+    entries = {
+        "classA/img0.jpg": _jpeg_bytes(rng.integers(0, 255, (48, 40, 3))),
+        "classA/img1.jpg": _jpeg_bytes(rng.integers(0, 255, (64, 48, 3))),
+        "classB/img2.jpg": _jpeg_bytes(rng.integers(0, 255, (40, 56, 3))),
+        # too small on one side: must be skipped (ImageUtils.scala:20-23)
+        "classB/small.jpg": _jpeg_bytes(rng.integers(0, 255, (20, 80, 3))),
+        # not an image at all: must be skipped, not crash
+        "classB/junk.txt": b"not an image",
+    }
+    p = tmp_path / "imgs.tar"
+    _make_tar(p, entries)
+    return str(p)
+
+
+def test_decode_rules():
+    rng = np.random.default_rng(1)
+    ok = decode_image_bytes(_jpeg_bytes(rng.integers(0, 255, (50, 40, 3))))
+    assert ok.shape == (50, 40, 3) and ok.dtype == np.float32
+    assert decode_image_bytes(b"garbage") is None
+    small = _jpeg_bytes(rng.integers(0, 255, (MIN_DIM - 1, 100, 3)))
+    assert decode_image_bytes(small) is None
+    gray = decode_image_bytes(
+        _jpeg_bytes(rng.integers(0, 255, (40, 40)))
+    )
+    assert gray.shape == (40, 40, 1)
+    resized = decode_image_bytes(
+        _jpeg_bytes(rng.integers(0, 255, (50, 40, 3))), size=(44, 36)
+    )
+    assert resized.shape == (44, 36, 3)
+
+
+def test_tar_stream_skips_bad_entries(image_tar):
+    items = list(iter_tar_images(image_tar))
+    names = [n for n, _ in items]
+    assert names == ["classA/img0.jpg", "classA/img1.jpg", "classB/img2.jpg"]
+    assert items[0][1].shape == (48, 40, 3)
+
+
+def test_imagenet_loader_ragged_and_canonical(image_tar, tmp_path):
+    labels_file = tmp_path / "labels"
+    labels_file.write_text("classA 3\nclassB 7\n")
+
+    ragged = load_imagenet(image_tar, str(labels_file))
+    assert len(ragged) == 3
+    assert list(ragged.labels) == [3, 3, 7]
+    assert not ragged.data.is_batched  # native sizes stay per-item
+
+    canon = load_imagenet(image_tar, str(labels_file), size=(32, 32))
+    assert canon.data.is_batched
+    assert canon.data.to_array().shape == (3, 32, 32, 3)
+
+
+def test_voc_loader_multilabel(image_tar, tmp_path):
+    csv = tmp_path / "voclabels.csv"
+    csv.write_text(
+        '"id","class","classname","traintesteval","filename"\n'
+        '1,7,"car",1,"classA/img0.jpg"\n'
+        '2,13,"horse",1,"classA/img1.jpg"\n'
+        '2,15,"person",1,"classA/img1.jpg"\n'
+    )
+    voc = load_voc(image_tar, str(csv), name_prefix="classA/")
+    assert len(voc) == 2
+    assert voc.labels == [[6], [12, 14]]  # 1-indexed CSV → 0-indexed
+    Y = voc.label_matrix(20)
+    assert Y.shape == (2, 20)
+    assert Y[1, 12] == 1.0 and Y[1, 14] == 1.0 and Y[1, 0] == -1.0
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_voc_reference_fixture_oracle():
+    """Same assertions as the reference's VOCLoaderSuite.scala:9-31."""
+    voc = load_voc(
+        os.path.join(REF, "voc"),
+        os.path.join(REF, "voclabels.csv"),
+        name_prefix="VOCdevkit/VOC2007/JPEGImages/",
+    )
+    assert len(voc) == 10
+    (idx,) = [i for i, n in enumerate(voc.names) if n.endswith("000104.jpg")]
+    assert 14 in voc.labels[idx] and 19 in voc.labels[idx]
+    flat = [l for ls in voc.labels for l in ls]
+    assert len(flat) == 13
+    assert len(set(flat)) == 9
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_imagenet_reference_fixture_oracle():
+    imgs = load_imagenet(
+        os.path.join(REF, "imagenet"),
+        os.path.join(REF, "imagenet-test-labels"),
+        size=(64, 64),
+    )
+    assert len(imgs) > 0
+    assert set(imgs.labels.tolist()) == {12}
+    assert imgs.data.to_array().shape[1:] == (64, 64, 3)
