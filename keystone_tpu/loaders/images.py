@@ -110,6 +110,7 @@ def _tar_paths(data_path: str) -> List[str]:
             os.path.join(data_path, f)
             for f in os.listdir(data_path)
             if os.path.isfile(os.path.join(data_path, f))
+            and tarfile.is_tarfile(os.path.join(data_path, f))
         )
     return [data_path]
 
@@ -141,8 +142,8 @@ def read_labels_map(labels_path: str) -> Dict[str, int]:
             line = line.strip()
             if not line:
                 continue
-            name, num = line.split(" ")
-            out[name] = int(num)
+            parts = line.split()  # any whitespace, tolerant of runs/tabs
+            out[parts[0]] = int(parts[1])
     return out
 
 
@@ -199,12 +200,14 @@ class MultiLabeledImages:
         self.names = names
 
     def label_matrix(self, num_classes: int) -> np.ndarray:
-        """±1 multi-label indicator matrix (the solver-facing form)."""
-        Y = -np.ones((len(self.labels), num_classes), dtype=np.float32)
-        for i, ls in enumerate(self.labels):
-            for l in ls:
-                Y[i, l] = 1.0
-        return Y
+        """±1 multi-label indicator matrix (the solver-facing form),
+        via the canonical MultiClassLabelIndicators node."""
+        from ..nodes.util import MultiClassLabelIndicators
+
+        ds = MultiClassLabelIndicators(num_classes).apply_batch(
+            Dataset.from_items(list(self.labels))
+        )
+        return np.asarray(ds.to_array(), dtype=np.float32)
 
     def __len__(self) -> int:
         return len(self.names)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...data.dataset import Dataset
+from ...parallel.mesh import shard_classes
 from ...workflow.transformer import LabelEstimator
 from .linear import BlockLinearMapper
 
@@ -139,7 +140,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 C = max(1, self.class_chunk)
                 for c0 in range(0, k, C):
                     cs = slice(c0, min(c0 + C, k))
-                    mask = onehot[:, cs]  # (n, C)
+                    # model-axis parallelism: the class dim of the masked
+                    # Grams and the batched per-class Cholesky shards over
+                    # MODEL_AXIS (each model-device owns a slice of
+                    # classes); a 1-wide model axis makes this a no-op
+                    mask = shard_classes(onehot[:, cs], axis=1)  # (n, C)
                     grams = _chunk_grams(A, mask)  # (C, d, d)
                     cnt = counts[cs][:, None, None]
                     mu_c = class_means[cs]  # (C, d)
@@ -163,7 +168,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         - joint_means[cs] * mean_mixture[:, None]
                     )  # (C, d)
                     rhs = jointXTR - lam * Ws[j][:, cs].T
-                    delta_cols.append(_batched_solve(jointXTX, rhs, lam))
+                    delta_cols.append(
+                        _batched_solve(
+                            shard_classes(jointXTX), shard_classes(rhs), lam
+                        )
+                    )
                 delta = jnp.concatenate(delta_cols, axis=0).T  # (d, k)
                 Ws[j] = Ws[j] + delta
                 R = R - A @ delta

