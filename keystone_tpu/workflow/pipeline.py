@@ -337,27 +337,26 @@ class FittedPipeline(Chainable):
     def to_pipeline(self) -> Pipeline:
         return Pipeline(self._graph, self._source, self._sink)
 
-    # -- application (no full optimizer pass: parity with reference, which
-    #    applies FittedPipelines without re-optimizing; the one TPU-side
-    #    exception is trace fusion, which rewrites the transformer chain into
-    #    jitted blocks whose compiled executables persist across apply calls)
-
-    def _fused_graph(self) -> Graph:
-        if getattr(self, "_fused", None) is None:
-            from .fusion import TraceFusionRule
-
-            self._fused, _ = TraceFusionRule().apply(self._graph, {})
-        return self._fused
+    # -- application (no optimizer pass and NO re-fusion: parity with the
+    #    reference, which applies FittedPipelines without re-optimizing — and
+    #    a hard numerical invariant besides. The graph arrives here already
+    #    trace-fused by the optimizer (fit() runs fusion before estimators
+    #    execute), so every estimator was fit on features computed under
+    #    exactly this program partitioning. Re-fusing after fit would merge
+    #    the replaced transformer nodes into NEW XLA programs whose
+    #    reassociated float32 arithmetic can disagree with what the solver
+    #    trained on — observed as Fisher-Vector posterior assignments
+    #    flipping between fit and apply, i.e. a broken model.)
 
     def apply(self, data: Any) -> Dataset:
-        graph, data_id = attach_data(self._fused_graph(), data)
+        graph, data_id = attach_data(self._graph, data)
         graph = graph.replace_dependency(self._source, data_id)
         graph = graph.remove_source(self._source)
         executor = GraphExecutor(graph, optimize=False)
         return executor.execute(self._sink).get()
 
     def apply_datum(self, datum: Any) -> Any:
-        graph, datum_id = attach_datum(self._fused_graph(), datum)
+        graph, datum_id = attach_datum(self._graph, datum)
         graph = graph.replace_dependency(self._source, datum_id)
         graph = graph.remove_source(self._source)
         executor = GraphExecutor(graph, optimize=False)

@@ -3,12 +3,15 @@
 
 import numpy as np
 
+from keystone_tpu.nodes.learning.weighted import BlockWeightedLeastSquaresEstimator
 from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
     ImageNetSiftLcsFVConfig,
+    build_predictor,
     run,
     synthetic_imagenet,
     top_k_err_percent,
 )
+from keystone_tpu.workflow.pipeline import FittedPipeline
 
 
 def test_top_k_err_percent_oracle():
@@ -36,6 +39,47 @@ def test_imagenet_sift_lcs_fv_end_to_end():
     # predictions are a (n, 5) int index matrix
     out = np.asarray(predictor(te_i).get().to_array())
     assert out.shape == (48, 5)
+
+
+def test_fitted_apply_reproduces_fit_time_features(monkeypatch):
+    """Regression: FittedPipeline.apply must execute the exact program
+    partitioning fit() used. Re-fusing the transformer chain after fit
+    compiled the Fisher-Vector posterior math into a new XLA program whose
+    reassociated f32 arithmetic flipped near-tied component assignments —
+    apply-time features silently diverged from what the solver trained on
+    (train top-5 error went 0% → 40%)."""
+    cap = {}
+    orig = BlockWeightedLeastSquaresEstimator.fit
+
+    def spy(self, data, labels):
+        cap["X"] = np.asarray(data.to_array())
+        return orig(self, data, labels)
+
+    monkeypatch.setattr(BlockWeightedLeastSquaresEstimator, "fit", spy)
+    num_classes = 8
+    tr_i, tr_l = synthetic_imagenet(32, num_classes, size=48, seed=1)
+    conf = ImageNetSiftLcsFVConfig(
+        desc_dim=8,
+        vocab_size=4,
+        num_pca_samples=20_000,
+        num_gmm_samples=20_000,
+        num_classes=num_classes,
+        lam=1e-4,
+    )
+    fitted = build_predictor(tr_i, tr_l, conf).fit()
+
+    # cut the fitted graph at the solver's input and re-apply to train data
+    g = fitted.graph
+    topk = [
+        n for n in g.nodes
+        if type(g.get_operator(n)).__name__ == "TopKClassifier"
+    ][0]
+    solver = g.get_dependencies(topk)[0]
+    feat = g.get_dependencies(solver)[0]
+    g2, sink2 = g.add_sink(feat)
+    sub = FittedPipeline(g2, fitted._source, sink2)
+    X_apply = np.asarray(sub.apply(tr_i).to_array())
+    np.testing.assert_array_equal(X_apply, cap["X"])
 
 
 def test_imagenet_pca_gmm_checkpoint_load(tmp_path):
