@@ -130,7 +130,10 @@ class SparseRows:
 
     def class_sums(self, onehot) -> jnp.ndarray:
         """onehotᵀ @ X without densifying: scatter-add values into a
-        (classes, d) accumulator. onehot: (n, k) → (k, d)."""
+        (classes, d) accumulator. onehot: (n, k) → (k, d). Pure jnp —
+        safe under jit/vmap. Callers with hard int labels should use
+        :meth:`label_sums`, which scatters (n, m) elements instead of
+        (n, m, k)."""
         onehot = jnp.asarray(onehot)
         k = onehot.shape[1]
         # (n, m, k) contributions scattered by feature index
@@ -143,6 +146,15 @@ class SparseRows:
             jnp.arange(k)[None, None, :], contrib.shape
         )
         return out.at[cls, idx].add(contrib)
+
+    def label_sums(self, y, k: int) -> jnp.ndarray:
+        """Per-class feature sums for hard int labels: (k, d) via ONE
+        (n, m)-element scatter-add (padded slots carry value 0, so they
+        add nothing wherever they land)."""
+        y = jnp.asarray(y, dtype=jnp.int32)
+        cls = jnp.broadcast_to(y[:, None], self.values.shape)
+        out = jnp.zeros((k, self.num_features), dtype=self.values.dtype)
+        return out.at[cls, self.indices].add(self.values)
 
     def density(self) -> float:
         n, d = self.shape

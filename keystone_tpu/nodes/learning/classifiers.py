@@ -77,7 +77,9 @@ class NaiveBayesEstimator(LabelEstimator):
         if isinstance(data.payload, SparseRows):
             X = data.payload
             n, d = X.shape
-            feat_sums = X.class_sums(onehot)  # (k, d) scatter-add on device
+            # hard int labels: one (n, m)-element scatter-add instead of the
+            # (n, m, k) soft-membership scatter class_sums would build
+            feat_sums = X.label_sums(y, k)
         else:
             X = jnp.asarray(data.to_array(), dtype=jnp.float32)
             n, d = X.shape

@@ -46,13 +46,16 @@ def _chunk_grams(A, mask_chunk):
 
 @jax.jit
 def _batched_solve(jointXTX, rhs, lam):
-    """(C, d, d), (C, d) → (C, d) ridge solves via batched Cholesky."""
+    """(C, d, d), (C, d) → (C, d) batched ridge solves.
+
+    LU with partial pivoting, not Cholesky: per-class covariances are
+    rank-deficient whenever d exceeds the class count (ImageNet FV:
+    d=4096, tens of images per class), and f32 Cholesky NaNs on the
+    resulting near-semidefinite jointXTX. The reference survives because
+    Breeze's ``\\`` is f64 LU (BlockWeightedLeastSquares.scala:294)."""
     d = jointXTX.shape[-1]
     G = jointXTX + lam * jnp.eye(d, dtype=jointXTX.dtype)
-    cho = jax.vmap(lambda g: jax.scipy.linalg.cho_factor(g, lower=True)[0])(G)
-    return jax.vmap(
-        lambda c, r: jax.scipy.linalg.cho_solve((c, True), r)
-    )(cho, rhs)
+    return jnp.linalg.solve(G, rhs[..., None])[..., 0]
 
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
@@ -115,6 +118,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         ]
         stats = [None] * len(blocks)  # (pop_cov, pop_mean, joint_means)
 
+        from ...utils.timing import phase
+
         for _ in range(self.num_iter):
             for j, A in enumerate(blocks):
                 d = A.shape[1]
@@ -175,7 +180,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     )
                 delta = jnp.concatenate(delta_cols, axis=0).T  # (d, k)
                 Ws[j] = Ws[j] + delta
-                R = R - A @ delta
+                # per-block phase (parity: the reference's per-block solve
+                # timing logs, BlockWeightedLeastSquares.scala:177-313);
+                # syncs only under KEYSTONE_PROFILE
+                with phase("wls.block") as out:
+                    R = R - A @ delta
+                    out.append(R)
 
         # final intercept (ref :310-315)
         b = joint_label_mean - sum(

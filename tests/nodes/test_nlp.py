@@ -280,9 +280,15 @@ def test_sparse_rows_numeric_oracle():
     np.testing.assert_allclose(
         np.asarray(sr.rmatmul(R)), dense.T @ R, rtol=1e-4, atol=1e-5
     )
-    onehot = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=n)]
+    y = rng.integers(0, 4, size=n)
+    onehot = np.eye(4, dtype=np.float32)[y]
     np.testing.assert_allclose(
         np.asarray(sr.class_sums(onehot)), onehot.T @ dense,
+        rtol=1e-4, atol=1e-5,
+    )
+    # hard-label fast path: one (n, m) scatter, same oracle
+    np.testing.assert_allclose(
+        np.asarray(sr.label_sums(y, 4)), onehot.T @ dense,
         rtol=1e-4, atol=1e-5,
     )
 
