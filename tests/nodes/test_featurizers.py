@@ -229,3 +229,241 @@ def test_hog_hist_matches_naive():
             for i in range(4):
                 feats[row, 27 + i] = 0.2357 * t[i]
     np.testing.assert_allclose(out[0], feats, rtol=1e-3, atol=1e-3)
+
+
+# ------------------------------------------------- SIFT numerical oracle
+
+
+def _sift_naive_one_scale(g, bin_size, step):
+    """Slow, readable per-descriptor SIFT for one scale on an already
+    smoothed grayscale image ``g`` (float64). Independent of the batched
+    implementation: explicit per-pixel gradient/orientation accumulation
+    and per-bin box sums. Spec: VLFeat dense SIFT with flat window
+    (VLFeat.cxx:40-210) — 8 orientation bins with linear interpolation,
+    4x4 spatial bins of side bin_size, window = round(1.5*bin_size)
+    box sums clipped to the image, L2-norm -> clamp 0.2 -> renorm.
+    Returns (num_desc, 128) unquantized descriptors + pre-clamp norms."""
+    X, Y = g.shape
+    gx = np.zeros_like(g)
+    gy = np.zeros_like(g)
+    for x in range(X):
+        for y in range(Y):
+            if x == 0:
+                gx[x, y] = g[1, y] - g[0, y]
+            elif x == X - 1:
+                gx[x, y] = g[X - 1, y] - g[X - 2, y]
+            else:
+                gx[x, y] = 0.5 * (g[x + 1, y] - g[x - 1, y])
+            if y == 0:
+                gy[x, y] = g[x, 1] - g[x, 0]
+            elif y == Y - 1:
+                gy[x, y] = g[x, Y - 1] - g[x, Y - 2]
+            else:
+                gy[x, y] = 0.5 * (g[x, y + 1] - g[x, y - 1])
+    omaps = np.zeros((X, Y, 8))
+    for x in range(X):
+        for y in range(Y):
+            mag = math.hypot(gx[x, y], gy[x, y])
+            theta = math.atan2(gy[x, y], gx[x, y]) % (2 * math.pi)
+            t = theta / (2 * math.pi) * 8
+            t0 = int(math.floor(t))
+            frac = t - t0
+            omaps[x, y, t0 % 8] += mag * (1 - frac)
+            omaps[x, y, (t0 + 1) % 8] += mag * frac
+
+    window = max(1, int(round(bin_size * 1.5)))
+    off = (window - bin_size) // 2
+    extent = 4 * bin_size
+    descs, norms = [], []
+    for x0 in range(0, X - extent + 1, step):
+        for y0 in range(0, Y - extent + 1, step):
+            vec = np.zeros(128)
+            for j in range(4):
+                for i in range(4):
+                    ax = min(max(x0 + i * bin_size - off, 0), X - window)
+                    ay = min(max(y0 + j * bin_size - off, 0), Y - window)
+                    box = omaps[ax : ax + window, ay : ay + window].sum((0, 1))
+                    for t in range(8):
+                        vec[t + 8 * i + 32 * j] = box[t]
+            nrm = np.linalg.norm(vec)
+            norms.append(nrm)
+            v = vec / max(nrm, 1e-12)
+            v = np.minimum(v, 0.2)
+            v = v / max(np.linalg.norm(v), 1e-12)
+            descs.append(v)
+    return np.asarray(descs), np.asarray(norms)
+
+
+def test_sift_one_scale_matches_naive_oracle():
+    from keystone_tpu.nodes.images.sift import _sift_one_scale
+
+    rng = np.random.default_rng(11)
+    g = rng.random((26, 30)).astype(np.float32)
+    bin_size, step = 4, 5
+    want, want_norms = _sift_naive_one_scale(g.astype(np.float64), bin_size, step)
+    got, got_norms = _sift_one_scale(jnp.asarray(g[None]), bin_size, step)
+    got = np.asarray(got[0])
+    got_norms = np.asarray(got_norms[0])
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got_norms, want_norms, rtol=1e-4, atol=1e-5)
+    # VLFeatSuite-style tolerance: >=99.5% of elements within 1/512 of the
+    # quantization scale (VLFeatSuite.scala:34-51), plus a tight allclose
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+    frac_close = np.mean(np.abs(got * 512 - want * 512) <= 1.0)
+    assert frac_close >= 0.995
+
+
+def test_sift_end_to_end_quantization_and_contrast():
+    """Full extractor (1 scale) vs oracle incl. the gaussian pre-smooth,
+    x512 short quantization and the 0.005 contrast zeroing."""
+    from keystone_tpu.nodes.images.sift import (
+        SIFTExtractor,
+        _gaussian_kernel1d,
+    )
+
+    rng = np.random.default_rng(12)
+    # flat (sub-threshold noise) everywhere except the bottom-right corner,
+    # so descriptors anchored near (0,0) are entirely flat and must be
+    # zeroed by the 0.005 contrast test while corner ones survive
+    img = np.full((1, 32, 32, 1), 0.5, dtype=np.float32)
+    img[0, :, :, 0] += 1e-5 * rng.random((32, 32)).astype(np.float32)
+    img[0, 24:, 24:, 0] = rng.random((8, 8)).astype(np.float32)
+    bin_size, step = 4, 4
+
+    k = _gaussian_kernel1d(bin_size / 6.0).astype(np.float64)
+    r = len(k) // 2
+    g = img[0, :, :, 0].astype(np.float64)
+    gp = np.pad(g, r, mode="edge")
+    sm = np.zeros_like(g)
+    for x in range(g.shape[0]):
+        for y in range(g.shape[1]):
+            sm[x, y] = (gp[x : x + 2 * r + 1, y + r] * k).sum()
+    gp2 = np.pad(sm, r, mode="edge")
+    sm2 = np.zeros_like(g)
+    for x in range(g.shape[0]):
+        for y in range(g.shape[1]):
+            sm2[x, y] = (gp2[x + r, y : y + 2 * r + 1] * k).sum()
+
+    want, norms = _sift_naive_one_scale(sm2, bin_size, step)
+    want[norms <= 0.005] = 0.0
+    want = np.minimum(np.floor(want * 512.0), 255.0)
+
+    ext = SIFTExtractor(step=step, bin_size=bin_size, num_scales=1)
+    got = np.asarray(ext.trace_batch(jnp.asarray(img)))[0].T  # (N, 128)
+    assert got.shape == want.shape
+    # integer-quantized values: exact match on >=99.5% (floor at bin edges
+    # can differ by 1 from float32 vs float64 rounding)
+    frac_equal = np.mean(np.abs(got - want) <= 1.0)
+    assert frac_equal >= 0.995
+    # the flat-region descriptors really got zeroed
+    assert (norms <= 0.005).any()
+    np.testing.assert_array_equal(got[norms <= 0.005], 0.0)
+
+
+# ------------------------------------------------ DAISY numerical oracle
+
+
+def _conv2_same_zero(a, kx, ky):
+    """Naive zero-padded 'same' separable convolution (spec:
+    ImageUtils.conv2D:226-344): correlate rows with kx then cols with ky."""
+    X, Y = a.shape
+    rx = (len(kx) - 1) // 2
+    ry = (len(ky) - 1) // 2
+    tmp = np.zeros_like(a)
+    for x in range(X):
+        for y in range(Y):
+            s = 0.0
+            for i, w in enumerate(kx):
+                xi = x + i - rx
+                if 0 <= xi < X:
+                    s += a[xi, y] * w
+            tmp[x, y] = s
+    out = np.zeros_like(a)
+    for x in range(X):
+        for y in range(Y):
+            s = 0.0
+            for i, w in enumerate(ky):
+                yi = y + i - ry
+                if 0 <= yi < Y:
+                    s += tmp[x, yi] * w
+            out[x, y] = s
+    return out
+
+
+def _daisy_naive(g, T, Q, R, H, border, stride):
+    """Slow readable DAISY (spec: DaisyExtractor.scala:28-201): Sobel-style
+    gradients, H rectified directional maps, Q-level gaussian cascade with
+    the sigma^2-increment kernels, ring sampling at radius R*(l+1)/Q with
+    the reference's theta = 2pi(a-1)/T convention, per-histogram L2 norm."""
+    conv_threshold = 1e-6
+    sigma_sq = [(R * n / (2.0 * Q)) ** 2 for n in range(Q + 1)]
+    kernels = []
+    for t in [b - a for a, b in zip(sigma_sq, sigma_sq[1:])]:
+        rad = int(
+            math.ceil(
+                math.sqrt(
+                    -2 * t * math.log(conv_threshold)
+                    - t * math.log(2 * math.pi * t)
+                )
+            )
+        )
+        xs = np.arange(-rad, rad + 1, dtype=np.float64)
+        kernels.append(np.exp(-(xs**2) / (2 * t)) / math.sqrt(2 * math.pi * t))
+
+    f1 = np.array([1.0, 0.0, -1.0])
+    f2 = np.array([1.0, 2.0, 1.0])
+    ix = _conv2_same_zero(g, f1, f2)
+    iy = _conv2_same_zero(g, f2, f1)
+
+    X, Y = g.shape
+    layers = []
+    first = []
+    for a in range(H):
+        ang = 2 * math.pi * a / H
+        m = np.maximum(math.cos(ang) * ix + math.sin(ang) * iy, 0.0)
+        first.append(_conv2_same_zero(m, kernels[0], kernels[0]))
+    layers.append(first)
+    for l in range(1, Q):
+        layers.append(
+            [_conv2_same_zero(p, kernels[l], kernels[l]) for p in layers[l - 1]]
+        )
+
+    kx = list(range(border, X - border, stride))
+    ky = list(range(border, Y - border, stride))
+    feature_size = H * (T * Q + 1)
+
+    def hist(level, px, py):
+        h = np.array([layers[level][a][px, py] for a in range(H)])
+        nrm = np.linalg.norm(h)
+        return h / nrm if nrm > 1e-8 else np.zeros(H)
+
+    out = np.zeros((feature_size, len(kx) * len(ky)))
+    for xi, x in enumerate(kx):
+        for yi, y in enumerate(ky):
+            d = xi * len(ky) + yi
+            out[:H, d] = hist(0, x, y)
+            for l in range(Q):
+                rad = R * (1.0 + l) / Q
+                for a in range(T):
+                    theta = 2 * math.pi * (a - 1) / T
+                    dx = int(round(rad * math.sin(theta)))
+                    dy = int(round(rad * math.cos(theta)))
+                    px = min(max(x + dx, 0), X - 1)
+                    py = min(max(y + dy, 0), Y - 1)
+                    col = H + a * Q * H + l * H
+                    out[col : col + H, d] = hist(l, px, py)
+    return out
+
+
+def test_daisy_matches_naive_oracle():
+    rng = np.random.default_rng(13)
+    g = rng.random((30, 30)).astype(np.float32)
+    T, Q, R, H, border, stride = 4, 2, 6, 4, 8, 6
+    want = _daisy_naive(g.astype(np.float64), T, Q, R, H, border, stride)
+    ext = DaisyExtractor(
+        daisy_t=T, daisy_q=Q, daisy_r=R, daisy_h=H,
+        pixel_border=border, stride=stride,
+    )
+    got = np.asarray(ext.trace_batch(jnp.asarray(g[None, :, :, None])))[0]
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
