@@ -1,0 +1,22 @@
+#!/bin/bash
+# Launch a keystone_tpu pipeline by its application name.
+#
+# Parity: the reference's bin/run-pipeline.sh:34-56 — the class-name
+# dispatcher, with the SPARK_HOME/local switch replaced by --backend
+# tpu|cpu and the OMP pinning kept for host-side BLAS/loader stability.
+#
+#   bin/run-pipeline.sh MnistRandomFFT --numFFTs 4 --blockSize 2048
+#   bin/run-pipeline.sh RandomPatchCifar --backend tpu --numFilters 100
+#   bin/run-pipeline.sh NewsgroupsPipeline --backend cpu --cpuDevices 8
+
+set -e
+FWDIR="$(cd "$(dirname "$0")/.."; pwd)"
+
+if [[ -z "$OMP_NUM_THREADS" ]]; then
+  CORES=$(( $(getconf _NPROCESSORS_ONLN 2>/dev/null || echo 2) / 2 ))
+  [[ $CORES -lt 1 ]] && CORES=1
+  export OMP_NUM_THREADS=$(( CORES > 32 ? 32 : CORES ))
+fi
+
+export PYTHONPATH="$FWDIR${PYTHONPATH:+:$PYTHONPATH}"
+exec python -m keystone_tpu "$@"

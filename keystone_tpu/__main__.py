@@ -1,0 +1,136 @@
+"""The CLI front door: ``python -m keystone_tpu <PipelineName> [args...]``.
+
+Parity: ``bin/run-pipeline.sh:34-56`` + ``run-main.sh`` in the reference —
+one entry point that dispatches a pipeline class name to its ``main``. The
+reference's ``--master``/SPARK_HOME switch becomes ``--backend tpu|cpu``:
+the jax platform is selected before any device is initialized, with the
+CPU backend optionally widened to a virtual N-device mesh (the local-mode
+stand-in for a slice, like ``local[n]``).
+
+Pipeline names match the reference application objects, e.g.::
+
+    python -m keystone_tpu MnistRandomFFT --numFFTs 4 --blockSize 2048
+    python -m keystone_tpu RandomPatchCifar --numFilters 100
+    python -m keystone_tpu LinearPixels          # cifar-extras family
+    python -m keystone_tpu VOCSIFTFisher --trainLocation voc.tar ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Callable, List, Optional
+
+
+def _mnist(argv):
+    from .pipelines.mnist_random_fft import main
+
+    return main(argv)
+
+
+def _random_patch_cifar(argv):
+    from .pipelines.random_patch_cifar import main
+
+    return main(argv)
+
+
+def _cifar_extra(app: str) -> Callable:
+    def run(argv):
+        from .pipelines.cifar_extras import main
+
+        return main([app, *argv])
+
+    return run
+
+
+def _voc(argv):
+    from .pipelines.voc_sift_fisher import main
+
+    return main(argv)
+
+
+def _imagenet(argv):
+    from .pipelines.imagenet_sift_lcs_fv import main
+
+    return main(argv)
+
+
+def _timit(argv):
+    from .pipelines.timit import main
+
+    return main(argv)
+
+
+def _newsgroups(argv):
+    from .pipelines.newsgroups import main
+
+    return main(argv)
+
+
+def _amazon(argv):
+    from .pipelines.amazon_reviews import main
+
+    return main(argv)
+
+
+def _stupid_backoff(argv):
+    from .pipelines.stupid_backoff_pipeline import main
+
+    return main(argv)
+
+
+#: reference application object name → runner
+PIPELINES = {
+    "MnistRandomFFT": _mnist,
+    "LinearPixels": _cifar_extra("LinearPixels"),
+    "RandomCifar": _cifar_extra("RandomCifar"),
+    "RandomPatchCifar": _random_patch_cifar,
+    "RandomPatchCifarAugmented": _cifar_extra("RandomPatchCifarAugmented"),
+    "RandomPatchCifarKernel": _cifar_extra("RandomPatchCifarKernel"),
+    "VOCSIFTFisher": _voc,
+    "ImageNetSiftLcsFV": _imagenet,
+    "TimitPipeline": _timit,
+    "NewsgroupsPipeline": _newsgroups,
+    "AmazonReviewsPipeline": _amazon,
+    "StupidBackoffPipeline": _stupid_backoff,
+}
+
+
+def _select_backend(backend: Optional[str], cpu_devices: int) -> None:
+    """Pick the jax platform BEFORE any device is touched. A sitecustomize
+    may have pre-imported jax, so env vars are too late — use the config
+    knob / virtual-device provisioner instead."""
+    if backend is None:
+        return
+    if backend == "cpu" and cpu_devices > 1:
+        from .parallel.virtual import provision_virtual_devices
+
+        provision_virtual_devices(cpu_devices)
+        return
+    import jax
+
+    jax.config.update("jax_platforms", backend)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(
+        prog="python -m keystone_tpu",
+        description="Run a pipeline (parity: bin/run-pipeline.sh).",
+    )
+    p.add_argument("pipeline", choices=sorted(PIPELINES))
+    p.add_argument(
+        "--backend", choices=["tpu", "cpu"], default=None,
+        help="jax platform; default = whatever jax picks",
+    )
+    p.add_argument(
+        "--cpuDevices", type=int, default=1,
+        help="with --backend cpu: virtual device count for a local mesh",
+    )
+    args, rest = p.parse_known_args(argv)
+    _select_backend(args.backend, args.cpuDevices)
+    return PIPELINES[args.pipeline](rest)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

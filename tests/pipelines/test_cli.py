@@ -1,0 +1,28 @@
+"""CLI front door: python -m keystone_tpu <PipelineName> dispatches to the
+pipeline mains (parity: bin/run-pipeline.sh:34-56)."""
+
+import pytest
+
+from keystone_tpu.__main__ import PIPELINES, main
+
+
+def test_registry_covers_reference_applications():
+    expected = {
+        "MnistRandomFFT", "LinearPixels", "RandomCifar", "RandomPatchCifar",
+        "RandomPatchCifarAugmented", "RandomPatchCifarKernel",
+        "VOCSIFTFisher", "ImageNetSiftLcsFV", "TimitPipeline",
+        "NewsgroupsPipeline", "AmazonReviewsPipeline", "StupidBackoffPipeline",
+    }
+    assert set(PIPELINES) == expected
+
+
+def test_dispatch_runs_mnist(capsys):
+    rc = main(["MnistRandomFFT", "--numFFTs", "2", "--blockSize", "512",
+               "--lambda", "100"])
+    assert rc == 0
+    assert "TEST Error" in capsys.readouterr().out
+
+
+def test_unknown_pipeline_rejected():
+    with pytest.raises(SystemExit):
+        main(["NoSuchPipeline"])
