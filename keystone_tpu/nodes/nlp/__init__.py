@@ -1,6 +1,7 @@
 """Text/NLP nodes (parity: nodes/nlp/ — StringUtils, ngrams, HashingTF,
 indexers, StupidBackoff, WordFrequencyEncoder)."""
 
+from .corenlp_lite import CoreNLPFeatureExtractor
 from .hashing import (
     HashingTF,
     NGramsHashingTF,
@@ -23,6 +24,7 @@ from .stupid_backoff import (
 from .text import LowerCase, Tokenizer, Trim
 
 __all__ = [
+    "CoreNLPFeatureExtractor",
     "HashingTF",
     "NGramsHashingTF",
     "java_string_hash",

@@ -1,0 +1,48 @@
+"""Behavioral parity with the reference's CoreNLPFeatureExtractorSuite
+(src/test/scala/keystoneml/nodes/nlp/CoreNLPFeatureExtractorSuite.scala):
+the same lemmatization / entity-extraction / n-gram assertions."""
+
+from keystone_tpu.nodes.nlp.corenlp_lite import (
+    CoreNLPFeatureExtractor,
+    lemmatize,
+)
+
+
+def test_lemmatization():
+    text = "jumping snakes lakes oceans hunted"
+    tokens = set(CoreNLPFeatureExtractor(range(1, 4)).apply(text))
+    for lemma in ("jump", "snake", "lake", "ocean", "hunt"):
+        assert lemma in tokens
+    for raw in ("jumping", "snakes", "lakes", "oceans", "hunted"):
+        assert raw not in tokens
+
+
+def test_entity_extraction():
+    text = "John likes cake and he lives in Florida"
+    tokens = set(CoreNLPFeatureExtractor(range(1, 4)).apply(text))
+    assert "PERSON" in tokens
+    assert "LOCATION" in tokens
+    assert "John" not in tokens and "john" not in tokens
+    assert "Florida" not in tokens and "florida" not in tokens
+
+
+def test_1_2_3_grams():
+    tokens = set(CoreNLPFeatureExtractor(range(1, 4)).apply("a b c d"))
+    assert {"a", "b", "c", "d"} <= tokens
+    assert {"a b", "b c", "c d"} <= tokens
+    assert {"a b c", "b c d"} <= tokens
+
+
+def test_grams_respect_sentence_boundaries():
+    tokens = CoreNLPFeatureExtractor([2]).apply("a b. c d")
+    assert "b c" not in tokens
+    assert "a b" in tokens and "c d" in tokens
+
+
+def test_lemmatizer_rules():
+    assert lemmatize("running") == "run"
+    assert lemmatize("making") == "make"
+    assert lemmatize("cities") == "city"
+    assert lemmatize("children") == "child"
+    assert lemmatize("glasses") == "glass"
+    assert lemmatize("sing") == "sing"  # no vowel before suffix: untouched
