@@ -27,13 +27,27 @@ from ...workflow.transformer import LabelEstimator, Transformer
 
 
 @jax.jit
-def _gaussian_block(X, Xb, gamma):
+def _gaussian_block_xla(X, Xb, gamma):
     """exp(−γ‖x−y‖²) for all (row of X, row of Xb): (n, b)
     (parity: computeKernel, KernelGenerator.scala:138-206)."""
     xn = jnp.sum(X * X, axis=1, keepdims=True)
     bn = jnp.sum(Xb * Xb, axis=1)
     sq = xn - 2.0 * (X @ Xb.T) + bn
     return jnp.exp(-gamma * jnp.maximum(sq, 0.0))
+
+
+def _gaussian_block(X, Xb, gamma):
+    """Kernel-block front door: the fused Pallas kernel on TPU when the
+    tile working set fits VMEM (ops/gaussian_kernel.py), identical-math
+    XLA lowering otherwise."""
+    from ...ops.gaussian_kernel import (
+        gaussian_kernel_block_pallas,
+        pallas_block_supported,
+    )
+
+    if pallas_block_supported(X.shape[0], X.shape[1], Xb.shape[0]):
+        return gaussian_kernel_block_pallas(X, Xb, gamma)
+    return _gaussian_block_xla(X, Xb, gamma)
 
 
 class BlockKernelMatrix:

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for hot ops where hand-tiling beats or stabilizes
+the XLA lowering. Current kernels:
+
+* :mod:`.gaussian_kernel` — fused Gaussian kernel block (GEMM + norms +
+  exp in one VMEM-resident tile), the KRR hot loop's block generator.
+"""
+
+from .gaussian_kernel import (
+    gaussian_kernel_block_pallas,
+    pallas_block_supported,
+)
+
+__all__ = ["gaussian_kernel_block_pallas", "pallas_block_supported"]

@@ -1,0 +1,27 @@
+"""Pallas kernel correctness vs the XLA lowering (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.nodes.learning.kernel import _gaussian_block_xla
+from keystone_tpu.ops.gaussian_kernel import (
+    gaussian_kernel_block_pallas,
+    pallas_block_supported,
+)
+
+
+def test_pallas_gaussian_block_matches_xla_interpret():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((700, 128)).astype(np.float32)  # non-tile-multiple n
+    Xb = rng.standard_normal((256, 128)).astype(np.float32)
+    want = np.asarray(_gaussian_block_xla(jnp.asarray(X), jnp.asarray(Xb), 0.03))
+    got = np.asarray(
+        gaussian_kernel_block_pallas(X, Xb, 0.03, interpret=True)
+    )
+    assert got.shape == (700, 256)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_support_gate():
+    # CPU backend in tests: never claims support
+    assert not pallas_block_supported(4096, 512, 1024)
