@@ -243,10 +243,18 @@ def bench_mnist() -> dict:
 
 
 def bench_imagenet_fv() -> dict:
-    """BASELINE metric #2: featurize+predict throughput of the fitted
-    SIFT+LCS Fisher-Vector pipeline at the reference feature config
-    (descDim=64, vocabSize=16 — ImageNetSiftLcsFV.scala:146-167), measured
-    steady-state after compile on a canonical 96×96 batch."""
+    """BASELINE metric #2: the SIFT+LCS Fisher-Vector pipeline.
+
+    Config vs the reference workload (ImageNetSiftLcsFV.scala:146-167):
+    descDim=64 and vocabSize=16 match the reference defaults; images are
+    224×224 synthetic textures (reference: variable-size real photos,
+    commonly ≥256 px) over 100 classes (reference: 1000) with 300 train /
+    96 test images (reference: 1.28M) — the per-image featurization work
+    is representative, the dataset scale is not, and the JSON says so.
+    Throughput is measured on a device-resident batch (the H2D upload of
+    a batch is timed separately — through this tunnel it can exceed the
+    compute); top-5 error on the held-out synthetic set is recorded.
+    """
     import jax
     import numpy as np
 
@@ -254,9 +262,12 @@ def bench_imagenet_fv() -> dict:
         ImageNetSiftLcsFVConfig,
         build_predictor,
         synthetic_imagenet,
+        top_k_err_percent,
     )
+    from keystone_tpu.utils import timing
 
-    num_classes = 64
+    num_classes = 100
+    image_size = 224
     conf = ImageNetSiftLcsFVConfig(
         desc_dim=64,
         vocab_size=16,
@@ -265,40 +276,139 @@ def bench_imagenet_fv() -> dict:
         num_classes=num_classes,
         lam=1e-4,
     )
-    tr_i, tr_l = synthetic_imagenet(128, num_classes, size=96, seed=1)
+    tr_i, tr_l = synthetic_imagenet(300, num_classes, size=image_size, seed=1)
+    te_i, te_l = synthetic_imagenet(96, num_classes, size=image_size, seed=9)
 
+    timing.reset()
     t0 = time.perf_counter()
     predictor = build_predictor(tr_i, tr_l, conf)
     fitted = predictor.fit()
     t_fit = time.perf_counter() - t0
+    fit_phases = timing.snapshot()
 
-    batch = synthetic_imagenet(64, num_classes, size=96, seed=9)[0]
-    t1 = time.perf_counter()
-    _ = jax.block_until_ready(np.asarray(fitted.apply(batch).to_array()))
-    t_compile = time.perf_counter() - t1
+    # held-out top-5 error (the reference's quality metric, :139-141)
+    t0 = time.perf_counter()
+    te_pred = np.asarray(fitted.apply(te_i).to_array())
+    t_first_apply = time.perf_counter() - t0
+    top5_err = top_k_err_percent(te_pred, te_l)
 
-    # steady state: apply the fitted two-branch featurizer + model
-    reps = 3
-    t2 = time.perf_counter()
-    for _ in range(reps):
-        _ = jax.block_until_ready(np.asarray(fitted.apply(batch).to_array()))
-    t_apply = (time.perf_counter() - t2) / reps
-    ips = len(batch) / t_apply
+    # steady-state throughput on a device-resident batch
+    t0 = time.perf_counter()
+    batch = jax.device_put(te_i[:64])
+    _fetch_scalar(batch)
+    t_h2d = time.perf_counter() - t0
+    apply_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fitted.apply(batch).to_array()
+        _fetch_scalar(out)
+        apply_times.append(time.perf_counter() - t0)
+    t_apply = min(apply_times)
+    ips = 64 / t_apply
 
     return {
         "images_per_sec": round(ips, 2),
+        "top5_test_err_pct": round(top5_err, 2),
         "phases": {
-            "fit_128imgs": round(t_fit, 3),
-            "first_apply": round(t_compile, 3),
+            "fit_300imgs": round(t_fit, 3),
+            "first_apply_96imgs": round(t_first_apply, 3),
+            "h2d_64img_batch": round(t_h2d, 3),
             "steady_apply_64imgs": round(t_apply, 3),
         },
-        "config": "descDim=64 vocabSize=16 96x96 synthetic",
+        "fit_phase_table": fit_phases,
+        "apply_attempts": [round(t, 3) for t in apply_times],
+        "config": (
+            f"descDim=64 vocabSize=16 (reference defaults); "
+            f"{image_size}x{image_size} synthetic textures, "
+            f"{num_classes} classes, 300 train imgs (reference: real "
+            f"photos >=256px, 1000 classes, 1.28M imgs)"
+        ),
+    }
+
+
+def bench_text() -> dict:
+    """NLP featurization throughput (VERDICT r2 #9): docs/sec through the
+    host-side tokenize → n-gram → TF → CommonSparseFeatures substrate at
+    20k docs, against the device solve (NaiveBayes fit) it feeds.
+
+    The decision this measures: the n-gram substrate is per-document
+    Python. If featurization dwarfs the solve, move counting to the
+    packed-int64 indexer path; the recorded split is the evidence either
+    way."""
+    import numpy as np
+
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import NaiveBayesEstimator
+    from keystone_tpu.nodes.nlp import (
+        LowerCase,
+        NGramsFeaturizer,
+        Tokenizer,
+        Trim,
+    )
+    from keystone_tpu.nodes.stats import TermFrequency
+    from keystone_tpu.nodes.util import CommonSparseFeatures
+    from keystone_tpu.pipelines.newsgroups import synthetic_newsgroups
+
+    n_docs = 20_000
+    data = synthetic_newsgroups(n_docs, seed=5)
+
+    t0 = time.perf_counter()
+    featurizer = (
+        Trim()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer([1, 2]))
+        .and_then(TermFrequency(lambda x: 1))
+    )
+    tf = featurizer(data.data).get()
+    t_tf = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    sparse_est = CommonSparseFeatures(50_000)
+    vectorizer = sparse_est.fit(tf)
+    X = vectorizer.apply_batch(tf)
+    t_sparse = time.perf_counter() - t0
+
+    labels_ds = Dataset.of(np.asarray(data.labels.to_array()))
+    solve_attempts = []
+    for _ in range(2):  # attempt 1 includes the scatter compile
+        t0 = time.perf_counter()
+        _ = NaiveBayesEstimator(20).fit(X, labels_ds)
+        solve_attempts.append(time.perf_counter() - t0)
+    t_solve = min(solve_attempts)
+
+    t_feat = t_tf + t_sparse
+    ratio = t_feat / max(t_solve, 1e-9)
+    if ratio > 1.0:
+        decision = (
+            f"host featurization is {ratio:.1f}x the device solve at "
+            f"{n_docs} docs: move n-gram counting to the packed-int64 "
+            "indexer path before scaling the corpus"
+        )
+    else:
+        decision = (
+            f"the device solve, not host featurization, bounds this scale "
+            f"(featurize/solve = {ratio:.1f}); the per-document substrate "
+            "is acceptable — revisit if corpora grow ~10x"
+        )
+    return {
+        "docs_per_sec_featurize": round(n_docs / t_feat, 1),
+        "phases": {
+            "tokenize_ngram_tf": round(t_tf, 3),
+            "common_sparse_vectorize": round(t_sparse, 3),
+            "naive_bayes_fit": round(t_solve, 3),
+        },
+        "solve_attempts": [round(t, 3) for t in solve_attempts],
+        "n_docs": n_docs,
+        "featurize_vs_solve_ratio": round(ratio, 2),
+        "decision": decision,
     }
 
 
 def main() -> int:
     mnist = bench_mnist()
     imagenet = bench_imagenet_fv()
+    text = bench_text()
     print(
         json.dumps(
             {
@@ -317,6 +427,7 @@ def main() -> int:
                 "extra": {
                     "mnist": mnist,
                     "imagenet_sift_lcs_fv": imagenet,
+                    "text_featurization": text,
                 },
             }
         )

@@ -238,10 +238,14 @@ def synthetic_imagenet(n: int, num_classes: int, size: int = 64, seed: int = 0):
     xx, yy = np.meshgrid(np.arange(size), np.arange(size), indexing="ij")
     images = np.zeros((n, size, size, 3), dtype=np.float32)
     labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    # class signal lives on a (frequency × orientation) grid so classes
+    # stay separable as num_classes grows (10 freqs × orientations)
+    n_freq = min(10, max(1, int(np.ceil(np.sqrt(num_classes)))))
+    n_theta = max(1, -(-num_classes // n_freq))
     for i in range(n):
         cl = int(labels[i])
-        freq = 0.10 + 0.04 * (cl % 8)
-        theta = np.pi * cl / max(num_classes, 1)
+        freq = 0.08 + 0.035 * (cl % n_freq)
+        theta = np.pi * (cl // n_freq) / n_theta
         wave = 80.0 * np.sin(
             2 * np.pi * freq * (np.cos(theta) * xx + np.sin(theta) * yy)
             + rng.uniform(0, 2 * np.pi)
