@@ -73,6 +73,7 @@ def _fetch_scalar(x) -> None:
 
 def bench_mnist() -> dict:
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from keystone_tpu.evaluation.multiclass import MulticlassClassifierEvaluator
@@ -111,20 +112,47 @@ def bench_mnist() -> dict:
 
     # -- phase: data placement. Real CSVs are read on host and uploaded (the
     #    reference's analogue: data resident in RDDs before its timer);
-    #    synthetic data is generated directly in HBM — no bulk H2D.
-    t0 = time.perf_counter()
-    if train is not None:
-        Xtr = jax.device_put(np.asarray(train.data.to_array(), dtype=np.float32))
-        Xte = jax.device_put(np.asarray(test.data.to_array(), dtype=np.float32))
-    else:
-        train, test = synthetic_mnist_device(
-            n_train=60000, n_test=10000, seed=42
-        )
-        data_source = "synthetic (device-generated)"
-        Xtr = train.data.to_array()
-        Xte = test.data.to_array()
-    _fetch_scalar(Xte)
-    t_upload = time.perf_counter() - t0
+    #    synthetic data is generated directly in HBM — no bulk H2D. Same
+    #    two-attempt-min policy as fit/apply: the first device touch of the
+    #    process pays backend init + generator compile + tunnel warmup
+    #    (measured 13-62 s for ~1 s of actual work), which is process
+    #    warmup, not data movement — attempts recorded, min reported.
+    from_csv = train is not None
+    upload_attempts = []
+    for attempt in range(2):
+        # vary the payload on the re-measure (fresh seed / one perturbed
+        # element) so a memoizing transport cannot hand back attempt 0's
+        # buffers; the fit keeps using attempt 0's data
+        t0 = time.perf_counter()
+        if from_csv:
+            tr_arr = np.asarray(train.data.to_array(), dtype=np.float32)
+            te_arr = np.asarray(test.data.to_array(), dtype=np.float32)
+            if attempt:
+                tr_arr = tr_arr.copy()
+                tr_arr[0, 0] += attempt
+                te_arr = te_arr.copy()
+                te_arr[0, 0] += attempt
+            Xtr_i = jax.device_put(tr_arr)
+            Xte_i = jax.device_put(te_arr)
+            _fetch_scalar(Xtr_i)  # the two uploads are separate transfers
+        else:
+            tr_i, te_i = synthetic_mnist_device(
+                n_train=60000, n_test=10000, seed=42 + attempt
+            )
+            Xtr_i = tr_i.data.to_array()
+            Xte_i = te_i.data.to_array()
+        _fetch_scalar(Xte_i)
+        upload_attempts.append(time.perf_counter() - t0)
+        if attempt == 0:
+            Xtr, Xte = Xtr_i, Xte_i
+            if not from_csv:
+                train, test = tr_i, te_i
+                data_source = "synthetic (device-generated)"
+    t_upload = min(upload_attempts)
+    # drop the re-measure's duplicate device buffers before the timed phases
+    del Xtr_i, Xte_i
+    if not from_csv:
+        del tr_i, te_i
 
     # D2H scalar fetch latency, to interpret the phase numbers
     lat = []
@@ -133,6 +161,23 @@ def bench_mnist() -> dict:
         _fetch_scalar(Xtr[i, i])
         lat.append(time.perf_counter() - t)
     fetch_latency = min(lat)
+
+    # Per-dispatch floor of the device transport. Calibration on this
+    # tunnel: a 4096^3 matmul (0.7 ms of MXU time) and an 8192^3 matmul
+    # (11 ms) both take ~20 ms, and chained dispatches do NOT pipeline —
+    # every op pays a ~20 ms round trip. Short-program measurements
+    # (solve_steady, and hence mfu_solve_*) are bounded by this floor,
+    # not by device utilization; recorded so readers can subtract.
+    tiny = jnp.zeros((8, 8), dtype=jnp.float32) + 1.0
+    tiny_step = jax.jit(lambda a, s: a * s)
+    _fetch_scalar(tiny_step(tiny, 1.0))
+    floors = []
+    for trial in range(3):
+        t = time.perf_counter()
+        outs = [tiny_step(tiny, 1.0 + 1e-6 * (trial * 4 + i)) for i in range(4)]
+        _fetch_scalar(outs[-1])
+        floors.append((time.perf_counter() - t - fetch_latency) / 4)
+    dispatch_floor = max(min(floors), 0.0)
 
     # -- phase: fit (featurize 60k + block solve). The tunneled device
     #    transport intermittently stalls for 30-60 s independent of the
@@ -192,8 +237,8 @@ def bench_mnist() -> dict:
     # Solve utilization. Flops: per uniform block b — Gram 2·n·b² +
     # Cholesky b³/3 (cross/update terms are k-thin, negligible); d measured
     # from the real featurizer output so config changes can't silently skew
-    # the MFU. Steady MFU from dedicated solve reps with forced completion
-    # (min of 5), e2e MFU against the whole best fit.
+    # the MFU. Steady MFU from fetch-amortized chained solve trials (see
+    # below); e2e MFU against the whole best fit.
     n = int(Xtr.shape[0])
     F = build_featurizer(conf)(Xtr).get().to_array()
     d = int(F.shape[-1])
@@ -206,18 +251,28 @@ def bench_mnist() -> dict:
     y = jax.device_put(
         np.asarray(labels.to_array(), dtype=np.float32)
     )
+    # the solve is ~0.1 s — the same order as one D2H fetch through the
+    # tunnel — so per-rep timing drowns in transport noise. Amortize:
+    # each trial times CHAIN back-to-back solves (reg eps-varied per rep
+    # so a memoizing transport can't replay; reg is traced, no recompiles)
+    # with one forced fetch at the end, then divides.
+    CHAIN = 3
     solve_times = []
-    for i in range(5):
-        # vary reg by epsilon so a memoizing device transport cannot return
-        # a cached result; reg is a traced scalar, so no recompiles
+    for trial in range(3):
         t0 = time.perf_counter()
-        Ws = solve_blockwise_l2(
-            F_blocks, y, reg=conf.lam * (1.0 + (i + 1) * 1e-7)
+        last = None
+        for i in range(CHAIN):
+            Ws = solve_blockwise_l2(
+                F_blocks, y,
+                reg=conf.lam * (1.0 + (trial * CHAIN + i + 1) * 1e-7),
+            )
+            # the LAST block transitively depends on every earlier block
+            # via the pred chain, so fetching it forces the whole solve
+            last = Ws[-1]
+        _fetch_scalar(last)
+        solve_times.append(
+            (time.perf_counter() - t0 - fetch_latency) / CHAIN
         )
-        # the LAST block transitively depends on every earlier block via
-        # the pred chain, so fetching it forces the whole solve
-        _fetch_scalar(Ws[-1])
-        solve_times.append(time.perf_counter() - t0 - fetch_latency)
     t_solve_steady = max(min(solve_times), 1e-9)
     peak = _device_peak_flops()
     return {
@@ -229,10 +284,12 @@ def bench_mnist() -> dict:
             "apply_10k_steady": round(t_apply, 3),
             "solve_steady": round(t_solve_steady, 4),
         },
+        "data_placement_attempts": [round(t, 3) for t in upload_attempts],
         "fit_attempts": [round(t, 3) for t in fit_attempts],
         "apply_attempts": [round(t, 3) for t in apply_times],
         "fit_phase_tables": fit_phase_tables,
         "d2h_fetch_latency": round(fetch_latency, 4),
+        "transport_dispatch_floor_seconds": round(dispatch_floor, 4),
         "compile_cache": "cold" if cache_cold else "warm",
         "test_err_pct": round(100 * test_err, 2),
         "data": data_source,

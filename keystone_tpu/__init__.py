@@ -4,7 +4,9 @@ A ground-up rebuild of the capabilities of KeystoneML (AMPLab's Spark-based
 pipeline system): Transformers and Estimators compose with ``and_then`` into a
 lazily-optimized dataflow DAG, but execution is jax/XLA — fitted pipelines
 compile into a single fused XLA computation, solvers run on HBM-sharded arrays
-with ICI collectives, and featurizers are batched jax/Pallas kernels.
+with ICI collectives, featurizers are batched XLA programs over canonical
+(n, X, Y, C) image batches, and hand-tiled Pallas kernels take over where
+XLA's lowering is unstable (``ops/`` — e.g. the KRR Gaussian kernel block).
 """
 
 import os as _os

@@ -13,6 +13,7 @@ the block model applies as one MXU matmul.
 from __future__ import annotations
 
 import argparse
+import functools
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -96,33 +97,44 @@ def synthetic_mnist(
     return make(n_train), make(n_test)
 
 
+def _synthetic_mnist_gen(key, n_train: int, n_test: int):
+    import jax
+    import jax.numpy as jnp
+
+    kp, k1, k2, k3, k4 = jax.random.split(key, 5)
+    protos = jax.random.normal(
+        kp, (NUM_CLASSES, MNIST_IMAGE_SIZE), jnp.float32
+    )
+
+    def make(ky, kn, n):
+        y = jax.random.randint(ky, (n,), 0, NUM_CLASSES)
+        X = protos[y] + 2.0 * jax.random.normal(
+            kn, (n, MNIST_IMAGE_SIZE), jnp.float32
+        )
+        return y, X
+
+    return make(k1, k2, n_train) + make(k3, k4, n_test)
+
+
+@functools.lru_cache(maxsize=1)
+def _synthetic_mnist_gen_jit():
+    import jax
+
+    return jax.jit(_synthetic_mnist_gen, static_argnums=(1, 2))
+
+
 def synthetic_mnist_device(
     n_train: int = 8192, n_test: int = 2048, seed: int = 42
 ) -> tuple:
     """Same task as :func:`synthetic_mnist` generated directly in HBM —
     no host→device bulk transfer (which through a tunneled device transport
     can dwarf every compute phase). Labels come back to host (tiny) for the
-    evaluators."""
+    evaluators. The generator is a process-cached jit so repeated calls
+    (e.g. the bench's warm re-measure) reuse the compiled executable."""
     import jax
-    import jax.numpy as jnp
 
-    @jax.jit
-    def gen(key):
-        kp, k1, k2, k3, k4 = jax.random.split(key, 5)
-        protos = jax.random.normal(
-            kp, (NUM_CLASSES, MNIST_IMAGE_SIZE), jnp.float32
-        )
-
-        def make(ky, kn, n):
-            y = jax.random.randint(ky, (n,), 0, NUM_CLASSES)
-            X = protos[y] + 2.0 * jax.random.normal(
-                kn, (n, MNIST_IMAGE_SIZE), jnp.float32
-            )
-            return y, X
-
-        return make(k1, k2, n_train) + make(k3, k4, n_test)
-
-    y_tr, X_tr, y_te, X_te = gen(jax.random.PRNGKey(seed))
+    gen = _synthetic_mnist_gen_jit()
+    y_tr, X_tr, y_te, X_te = gen(jax.random.PRNGKey(seed), n_train, n_test)
     y_tr = np.asarray(y_tr).astype(np.int32)
     y_te = np.asarray(y_te).astype(np.int32)
     return LabeledData(y_tr, X_tr), LabeledData(y_te, X_te)
