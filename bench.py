@@ -385,13 +385,15 @@ def bench_imagenet_fv() -> dict:
 
 def bench_text() -> dict:
     """NLP featurization throughput (VERDICT r2 #9): docs/sec through the
-    host-side tokenize → n-gram → TF → CommonSparseFeatures substrate at
-    20k docs, against the device solve (NaiveBayes fit) it feeds.
+    host featurization substrate at 20k docs vs the device solve
+    (NaiveBayes fit) it feeds.
 
-    The decision this measures: the n-gram substrate is per-document
-    Python. If featurization dwarfs the solve, move counting to the
-    packed-int64 indexer path; the recorded split is the evidence either
-    way."""
+    Round 2 measured the per-document composed chain (NGramsFeaturizer →
+    TermFrequency → CommonSparseFeatures) at 16.6x the solve and recorded
+    the decision to move counting to the packed-int64 path. Round 3 ships
+    that path (nodes/nlp/packed_features.py, output-identical, now what
+    the text pipelines use); this bench measures BOTH so the speedup is a
+    recorded fact, not a claim."""
     import numpy as np
 
     from keystone_tpu.data.dataset import Dataset
@@ -399,6 +401,7 @@ def bench_text() -> dict:
     from keystone_tpu.nodes.nlp import (
         LowerCase,
         NGramsFeaturizer,
+        PackedTextFeatures,
         Tokenizer,
         Trim,
     )
@@ -410,21 +413,40 @@ def bench_text() -> dict:
     data = synthetic_newsgroups(n_docs, seed=5)
 
     t0 = time.perf_counter()
-    featurizer = (
-        Trim()
-        .and_then(LowerCase())
-        .and_then(Tokenizer())
-        .and_then(NGramsFeaturizer([1, 2]))
-        .and_then(TermFrequency(lambda x: 1))
-    )
-    tf = featurizer(data.data).get()
-    t_tf = time.perf_counter() - t0
+    tokens = (
+        Trim().and_then(LowerCase()).and_then(Tokenizer())
+    )(data.data).get()
+    docs = Dataset.from_items([list(d) for d in tokens])
+    t_tok = time.perf_counter() - t0
 
+    # composed per-document chain (the reference's shape)
     t0 = time.perf_counter()
-    sparse_est = CommonSparseFeatures(50_000)
-    vectorizer = sparse_est.fit(tf)
-    X = vectorizer.apply_batch(tf)
-    t_sparse = time.perf_counter() - t0
+    tf = NGramsFeaturizer([1, 2]).and_then(
+        TermFrequency(lambda x: 1)
+    )(docs).get()
+    vectorizer = CommonSparseFeatures(50_000).fit(tf)
+    X_composed = vectorizer.apply_batch(tf)
+    t_composed = time.perf_counter() - t0
+
+    # fused corpus-level packed-int64 path (what the pipelines run)
+    t0 = time.perf_counter()
+    packed = PackedTextFeatures([1, 2], 50_000, lambda x: 1).fit(docs)
+    X = packed.apply_batch(docs)
+    t_packed = time.perf_counter() - t0
+
+    # both paths construct SparseRows the same way (rows sorted by column,
+    # capacity rounded up from max nnz), so padded-array equality is exact
+    # equality — no 20k x 50k densification
+    same = bool(
+        np.array_equal(
+            np.asarray(X.payload.indices),
+            np.asarray(X_composed.payload.indices),
+        )
+        and np.allclose(
+            np.asarray(X.payload.values),
+            np.asarray(X_composed.payload.values),
+        )
+    )
 
     labels_ds = Dataset.of(np.asarray(data.labels.to_array()))
     solve_attempts = []
@@ -434,31 +456,28 @@ def bench_text() -> dict:
         solve_attempts.append(time.perf_counter() - t0)
     t_solve = min(solve_attempts)
 
-    t_feat = t_tf + t_sparse
+    t_feat = t_tok + t_packed
     ratio = t_feat / max(t_solve, 1e-9)
-    if ratio > 1.0:
-        decision = (
-            f"host featurization is {ratio:.1f}x the device solve at "
-            f"{n_docs} docs: move n-gram counting to the packed-int64 "
-            "indexer path before scaling the corpus"
-        )
-    else:
-        decision = (
-            f"the device solve, not host featurization, bounds this scale "
-            f"(featurize/solve = {ratio:.1f}); the per-document substrate "
-            "is acceptable — revisit if corpora grow ~10x"
-        )
     return {
         "docs_per_sec_featurize": round(n_docs / t_feat, 1),
         "phases": {
-            "tokenize_ngram_tf": round(t_tf, 3),
-            "common_sparse_vectorize": round(t_sparse, 3),
+            "tokenize": round(t_tok, 3),
+            "ngram_tf_common_composed": round(t_composed, 3),
+            "ngram_tf_common_packed": round(t_packed, 3),
             "naive_bayes_fit": round(t_solve, 3),
         },
+        "packed_speedup_over_composed": round(t_composed / t_packed, 2),
+        "packed_equals_composed": same,
         "solve_attempts": [round(t, 3) for t in solve_attempts],
         "n_docs": n_docs,
         "featurize_vs_solve_ratio": round(ratio, 2),
-        "decision": decision,
+        "decision": (
+            f"r2's decision executed: the packed path is "
+            f"{t_composed / t_packed:.1f}x the composed chain and is what "
+            f"the pipelines run; remaining featurize/solve ratio "
+            f"{ratio:.1f} is tokenization + token-id dict lookups "
+            "(host string work with no array form)"
+        ),
     }
 
 

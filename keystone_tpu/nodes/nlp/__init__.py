@@ -10,6 +10,7 @@ from .hashing import (
     scala_hash,
 )
 from .indexers import NaiveBitPackIndexer, NGramIndexerImpl
+from .packed_features import PackedTextFeatures, PackedTextVectorizer
 from .ngrams import (
     NGramsCounts,
     NGramsFeaturizer,
@@ -34,6 +35,8 @@ __all__ = [
     "NGramIndexerImpl",
     "NGramsCounts",
     "NGramsFeaturizer",
+    "PackedTextFeatures",
+    "PackedTextVectorizer",
     "WordFrequencyEncoder",
     "WordFrequencyTransformer",
     "StupidBackoffEstimator",

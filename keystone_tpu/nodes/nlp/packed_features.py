@@ -1,0 +1,284 @@
+"""Fused, vectorized text featurization over packed-int64 n-grams.
+
+``PackedTextFeatures(orders, num_features, tf)`` is semantically identical
+to the composed chain
+
+    NGramsFeaturizer(orders) → TermFrequency(tf) →
+    CommonSparseFeatures(num_features)
+
+(parity: ngrams.scala:20-97 + TermFrequency.scala:18-21 +
+CommonSparseFeatures.scala:19-67 — the chain every reference text pipeline
+uses), but runs as corpus-level numpy array programs instead of
+per-document Python objects: token ids are packed into one int64 per
+n-gram (the 20-bit layout of :class:`..nlp.indexers.NaiveBitPackIndexer`),
+per-document counting is one lexsort + run-length pass over the whole
+corpus, and document-frequency ranking replicates the reference's
+(count desc, first-appearance asc) order bit-for-bit — including the
+first-appearance uid, which the composed chain derives from per-document
+first-occurrence order. Equality with the composed chain is pinned by
+tests/nodes/test_packed_features.py.
+
+Why it exists: the host featurization substrate is the measured bottleneck
+of the text pipelines (bench.py ``text_featurization``: featurize/solve
+ratio >> 1 at 20k docs). This is the same fusion philosophy the device
+side gets from whole-chain jit — collapse a chain of per-item stages into
+one batched program — applied to the host stages in front of the device
+boundary.
+
+Limits: n-gram orders must lie in {1, 2, 3} (the bit-pack layout) and the
+vocabulary must stay under 2^20 distinct tokens; both hold for every
+reference workload (newsgroups/amazon use 1-2 grams over <=1M-token
+vocabularies). Outside those bounds, use the composed chain.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...data.sparse import SparseRows, _round_up
+from ...workflow.transformer import Estimator, Transformer
+from .indexers import NaiveBitPackIndexer
+from .ngrams import validate_orders
+
+_WORD_BITS = 20
+_MAX_VOCAB = 1 << _WORD_BITS
+
+
+def _token_ids(
+    docs: Sequence[Sequence[str]],
+    vocab: Dict[str, int],
+    grow: bool,
+) -> List[np.ndarray]:
+    """Map token-list docs to int32 id arrays. ``grow=True`` extends the
+    vocabulary (fit); otherwise unknown tokens become -1 (apply)."""
+    out = []
+    if grow:
+        get = vocab.get
+        for doc in docs:
+            arr = np.empty(len(doc), dtype=np.int64)
+            for i, t in enumerate(doc):
+                j = get(t)
+                if j is None:
+                    j = len(vocab)
+                    vocab[t] = j
+                arr[i] = j
+            out.append(arr)
+    else:
+        get = vocab.get
+        for doc in docs:
+            out.append(
+                np.fromiter(
+                    (get(t, -1) for t in doc), dtype=np.int64, count=len(doc)
+                )
+            )
+    if len(vocab) > _MAX_VOCAB:
+        raise ValueError(
+            f"vocabulary {len(vocab)} exceeds the 2^{_WORD_BITS} packed-id "
+            "limit; use the composed NGramsFeaturizer chain"
+        )
+    return out
+
+
+def _corpus_grams(
+    ids_list: List[np.ndarray], orders: Sequence[int]
+) -> tuple:
+    """All n-grams of every doc as flat corpus-level arrays
+    ``(doc_ids, grams, emit_keys)`` — one vectorized pass per order over
+    the concatenated token stream, with grams crossing doc boundaries
+    masked out. ``emit_keys`` reproduces NGramsFeaturizer's emission order
+    (position-major, then order ascending) so first-occurrence ties rank
+    identically. OOV components (-1) drop the gram."""
+    n_docs = len(ids_list)
+    total = sum(len(a) for a in ids_list)
+    if total == 0:
+        e = np.empty(0, np.int64)
+        return e, e, e
+    flat = np.concatenate(ids_list) if total else np.empty(0, np.int64)
+    lengths = np.fromiter(
+        (len(a) for a in ids_list), dtype=np.int64, count=n_docs
+    )
+    doc_of = np.repeat(np.arange(n_docs, dtype=np.int64), lengths)
+    n_orders = len(orders)
+    parts_d, parts_g, parts_k = [], [], []
+    for oi, order in enumerate(orders):
+        if total < order:
+            continue
+        end = total - order + 1
+        # sliding word windows; one bit-pack via the canonical indexer so
+        # the int64 layout has a single source of truth
+        windows = np.stack(
+            [flat[j : end + j] for j in range(order)], axis=1
+        )
+        valid = (windows >= 0).all(axis=1) & (
+            doc_of[:end] == doc_of[order - 1 :]
+        )
+        packed = NaiveBitPackIndexer.pack_batch(windows, order)
+        idx = np.flatnonzero(valid)
+        parts_d.append(doc_of[idx])
+        parts_g.append(packed[idx])
+        parts_k.append(idx * n_orders + oi)
+    if not parts_d:
+        e = np.empty(0, np.int64)
+        return e, e, e
+    return (
+        np.concatenate(parts_d),
+        np.concatenate(parts_g),
+        np.concatenate(parts_k),
+    )
+
+
+def _per_doc_unique(doc_ids, flat, emit_keys) -> tuple:
+    """Corpus-level (doc_id, gram, count) for every distinct (doc, gram)
+    pair, ordered exactly like the composed chain's pair stream:
+    doc-major, within-doc first-emission order."""
+    # group by (doc, gram)
+    order = np.lexsort((flat, doc_ids))
+    d_s, g_s, p_s = doc_ids[order], flat[order], emit_keys[order]
+    if len(g_s):
+        new_group = np.empty(len(g_s), dtype=bool)
+        new_group[0] = True
+        new_group[1:] = (d_s[1:] != d_s[:-1]) | (g_s[1:] != g_s[:-1])
+        starts = np.flatnonzero(new_group)
+        counts = np.diff(np.append(starts, len(g_s)))
+        first_pos = np.minimum.reduceat(p_s, starts)
+        d_u, g_u = d_s[starts], g_s[starts]
+    else:
+        counts = np.zeros(0, dtype=np.int64)
+        first_pos = d_u = g_u = np.zeros(0, dtype=np.int64)
+    # uid order: docs in order, within doc by first occurrence
+    uid_order = np.lexsort((first_pos, d_u))
+    return d_u[uid_order], g_u[uid_order], counts[uid_order]
+
+
+def _apply_tf(counts: np.ndarray, fun: Optional[Callable]) -> np.ndarray:
+    if fun is None:
+        return counts.astype(np.float32)
+    distinct = np.unique(counts)
+    lut = np.asarray([float(fun(int(c))) for c in distinct], np.float32)
+    return lut[np.searchsorted(distinct, counts)]
+
+
+def _to_sparse_rows(
+    doc_ids: np.ndarray,
+    cols: np.ndarray,
+    values: np.ndarray,
+    n_docs: int,
+    num_features: int,
+) -> SparseRows:
+    """Padded SparseRows from flat (doc, col, value) triples, rows sorted
+    by column id like SparseFeatureVectorizer.apply."""
+    order = np.lexsort((cols, doc_ids))
+    d, c, v = doc_ids[order], cols[order], values[order]
+    nnz = np.bincount(d, minlength=n_docs).astype(np.int64)
+    m = _round_up(int(nnz.max()) if len(nnz) and nnz.max() > 0 else 1)
+    indices = np.zeros((n_docs, m), dtype=np.int32)
+    vals = np.zeros((n_docs, m), dtype=np.float32)
+    offsets = np.concatenate([[0], np.cumsum(nnz)[:-1]])
+    slot = np.arange(len(d)) - offsets[d]
+    indices[d, slot] = c
+    vals[d, slot] = v
+    return SparseRows(indices, vals, num_features)
+
+
+class PackedTextVectorizer(Transformer):
+    """Fitted vectorizer: token lists → SparseRows over the selected
+    n-gram feature space (the fused analogue of NGramsFeaturizer +
+    TermFrequency + SparseFeatureVectorizer)."""
+
+    def __init__(
+        self,
+        vocab: Dict[str, int],
+        selected: np.ndarray,
+        columns: np.ndarray,
+        orders: Sequence[int],
+        tf_fun: Optional[Callable],
+    ):
+        self.vocab = vocab
+        self.selected = selected  # sorted packed grams
+        self.columns = columns    # column id per selected gram
+        self.orders = list(orders)
+        self.tf_fun = tf_fun
+
+    @property
+    def num_features(self) -> int:
+        return len(self.selected)
+
+    def _match(self, docs) -> tuple:
+        """Flat (doc_ids, columns, tf_values) for every selected gram in
+        ``docs``, doc-major."""
+        ids = _token_ids(docs, self.vocab, grow=False)
+        d_u, g_u, counts = _per_doc_unique(
+            *_corpus_grams(ids, self.orders)
+        )
+        pos = np.searchsorted(self.selected, g_u)
+        pos = np.clip(pos, 0, max(len(self.selected) - 1, 0))
+        keep = (
+            (self.selected[pos] == g_u)
+            if len(self.selected)
+            else np.zeros(len(g_u), dtype=bool)
+        )
+        values = _apply_tf(counts[keep], self.tf_fun)
+        return d_u[keep], self.columns[pos[keep]], values
+
+    def _vectorize(self, docs) -> SparseRows:
+        d, c, v = self._match(docs)
+        return _to_sparse_rows(d, c, v, len(docs), self.num_features)
+
+    def apply(self, tokens):
+        # pair-list path, including zero tf values (a padded SparseRows
+        # row cannot represent those, but the composed chain's
+        # SparseFeatureVectorizer.apply emits them — stay identical)
+        _, cols, vals = self._match([list(tokens)])
+        order = np.argsort(cols)
+        return [
+            (int(c), float(v)) for c, v in zip(cols[order], vals[order])
+        ]
+
+    def apply_batch(self, data) -> Dataset:
+        docs = [list(doc) for doc in Dataset.of(data)]
+        return Dataset(self._vectorize(docs), batched=True)
+
+
+class PackedTextFeatures(Estimator):
+    """Fused NGramsFeaturizer(orders) → TermFrequency(tf) →
+    CommonSparseFeatures(num_features), vectorized over the whole corpus."""
+
+    def __init__(
+        self,
+        orders: Sequence[int],
+        num_features: int,
+        tf_fun: Optional[Callable] = None,
+    ):
+        orders = validate_orders(orders)
+        if max(orders) > 3:
+            raise ValueError(
+                "packed path supports orders <= 3; use the composed chain"
+            )
+        self.orders = orders
+        self.num_features = num_features
+        self.tf_fun = tf_fun
+
+    def fit(self, data: Dataset) -> PackedTextVectorizer:
+        docs = [list(doc) for doc in Dataset.of(data)]
+        vocab: Dict[str, int] = {}
+        ids = _token_ids(docs, vocab, grow=True)
+        _, g_u, _counts = _per_doc_unique(
+            *_corpus_grams(ids, self.orders)
+        )
+        # document frequency + first-seen uid over the uid-ordered stream
+        sel, first_seen, df = np.unique(
+            g_u, return_index=True, return_counts=True
+        )
+        rank = np.lexsort((first_seen, -df))[: self.num_features]
+        chosen = sel[rank]
+        sort_order = np.argsort(chosen)
+        return PackedTextVectorizer(
+            vocab,
+            chosen[sort_order],
+            np.arange(len(chosen), dtype=np.int64)[sort_order],
+            self.orders,
+            self.tf_fun,
+        )

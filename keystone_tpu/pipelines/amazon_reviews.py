@@ -2,8 +2,9 @@
 reviews with n-gram TF features and logistic regression.
 
 Parity: pipelines/text/AmazonReviewsPipeline.scala:16-80. Pipeline:
-Trim → LowerCase → Tokenizer → NGramsFeaturizer(1..nGrams) →
-TermFrequency(x→1) → (CommonSparseFeatures(commonFeatures), train) →
+Trim → LowerCase → Tokenizer → [NGramsFeaturizer(1..nGrams) →
+TermFrequency(x→1) → CommonSparseFeatures(commonFeatures)] (fused as
+PackedTextFeatures, output-identical) →
 (LogisticRegressionEstimator(2, numIters), train, labels),
 evaluated with BinaryClassifierEvaluator.
 
@@ -23,9 +24,8 @@ from ..data.dataset import Dataset
 from ..evaluation.binary import BinaryClassifierEvaluator
 from ..loaders.text import load_amazon_reviews
 from ..nodes.learning import LogisticRegressionEstimator
-from ..nodes.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
-from ..nodes.stats import TermFrequency
-from ..nodes.util import CommonSparseFeatures
+from ..nodes.nlp import LowerCase, Tokenizer, Trim
+from ..nodes.nlp.packed_features import PackedTextFeatures
 
 
 @dataclass
@@ -41,13 +41,21 @@ class AmazonReviewsConfig:
 
 
 def build_predictor(train_docs, train_labels, conf: AmazonReviewsConfig):
+    # fused host featurization — output-identical to the composed
+    # NGramsFeaturizer → TermFrequency → CommonSparseFeatures chain
+    # (tests/nodes/test_packed_features.py)
     return (
         Trim()
         .and_then(LowerCase())
         .and_then(Tokenizer())
-        .and_then(NGramsFeaturizer(list(range(1, conf.n_grams + 1))))
-        .and_then(TermFrequency(lambda x: 1))
-        .and_then(CommonSparseFeatures(conf.common_features), train_docs)
+        .and_then(
+            PackedTextFeatures(
+                list(range(1, conf.n_grams + 1)),
+                conf.common_features,
+                lambda x: 1,
+            ),
+            train_docs,
+        )
         .and_then(
             LogisticRegressionEstimator(2, num_iters=conf.num_iters),
             train_docs,
