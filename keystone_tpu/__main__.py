@@ -127,7 +127,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--cpuDevices", type=int, default=1,
         help="with --backend cpu: virtual device count for a local mesh",
     )
+    p.add_argument(
+        "--logLevel", default=None,
+        choices=["debug", "info", "warning", "error"],
+        help="log verbosity (default: $KEYSTONE_LOG or warning)",
+    )
+    p.add_argument(
+        "--profile", action="store_true",
+        help="per-phase device-time logs in the hot solvers "
+             "(also: KEYSTONE_PROFILE=1)",
+    )
     args, rest = p.parse_known_args(argv)
+    from .utils.obs import configure
+
+    configure(args.logLevel, profile=args.profile or None)
     _select_backend(args.backend, args.cpuDevices)
     return PIPELINES[args.pipeline](rest)
 

@@ -1,0 +1,76 @@
+"""Observability configuration: one switch for logs + phase profiling.
+
+Parity: the reference inherits its observability from Spark — log4j
+config, per-stage timing in the Spark UI, and ad-hoc ``logInfo`` phase
+logs in the hot solvers (e.g. KernelRidgeRegression.scala:216-224). The
+counterparts here:
+
+* ``configure(level)`` — process-wide stdlib logging with a timestamped
+  single-line format (the log4j analogue). Every module already logs
+  through ``logging.getLogger(__name__)``; this makes those logs visible
+  and uniform.
+* phase profiling — ``utils.timing`` accumulates named phase durations in
+  every hot solver; under profiling each phase exit synchronizes the
+  device stream so attribution is accurate, and phases log at INFO (the
+  Spark-UI-stage-timing analogue).
+
+Environment switches (read by the CLI and by ``configure(None)``):
+
+* ``KEYSTONE_LOG=debug|info|warning|error`` — log level.
+* ``KEYSTONE_PROFILE=1`` — enable phase profiling + phase logs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+_DATEFMT = "%H:%M:%S"
+
+_configured = False
+
+
+def configure(level: Optional[str] = None, profile: Optional[bool] = None) -> None:
+    """Configure logging (and optionally phase profiling) process-wide.
+
+    ``level=None`` reads ``KEYSTONE_LOG`` (default: warning, stdlib's
+    default visibility; unknown env values warn and fall back rather than
+    crash the CLI). ``profile`` is the single profiling switch: True/False
+    enable/disable phase syncs+logs, ``None`` follows ``KEYSTONE_PROFILE``
+    (off unless set to something truthy). Idempotent; later calls re-level
+    the root handler and re-apply the profiling switch.
+    """
+    global _configured
+    from_env = level is None
+    if from_env:
+        level = os.environ.get("KEYSTONE_LOG", "warning")
+    lvl = getattr(logging, str(level).upper(), None)
+    if not isinstance(lvl, int):
+        if not from_env:
+            raise ValueError(f"unknown log level: {level!r}")
+        # a bad env var should not crash the CLI — warn and fall back
+        logging.getLogger(__name__).warning(
+            "ignoring unknown KEYSTONE_LOG=%r (use debug|info|warning|error)",
+            level,
+        )
+        lvl = logging.WARNING
+    root = logging.getLogger()
+    if not _configured:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        root.addHandler(handler)
+        _configured = True
+    root.setLevel(lvl)
+
+    if profile is None:
+        raw = os.environ.get("KEYSTONE_PROFILE", "")
+        profile = raw.strip().lower() not in ("", "0", "false", "no", "off")
+    from . import timing
+
+    timing.enable(bool(profile))
+    if profile:
+        # phase logs are INFO; make sure they are visible when profiling
+        if lvl > logging.INFO:
+            root.setLevel(logging.INFO)

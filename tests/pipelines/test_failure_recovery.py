@@ -1,0 +1,96 @@
+"""Restart recovery across a REAL process death (VERDICT r2 §104): a KRR
+fit hard-killed mid-solve (os._exit — no finally blocks, no atexit) must
+resume in a fresh process from the on-disk checkpoint and land on the same
+model as an uninterrupted run. This is the process-level counterpart of
+the in-process simulated-preemption test in test_timit_cifar_extras.py —
+it additionally proves the checkpoint is durably on disk at kill time."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+
+import keystone_tpu  # noqa: F401  (registers compile cache)
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning.kernel import (
+    BlockKernelMatrix,
+    KernelRidgeRegression,
+)
+
+ckpt_dir = sys.argv[1]
+out_file = sys.argv[2]
+kill_after = int(sys.argv[3])
+
+rng = np.random.default_rng(7)
+X = rng.standard_normal((200, 16)).astype(np.float32)
+W_true = rng.standard_normal((16, 3)).astype(np.float32)
+Y = (X @ W_true + 0.01 * rng.standard_normal((200, 3))).astype(np.float32)
+
+if kill_after > 0:
+    orig = BlockKernelMatrix.block
+    calls = {"n": 0}
+
+    def dying(self, idxs):
+        calls["n"] += 1
+        if calls["n"] > kill_after:
+            os._exit(42)  # hard death: no finally, no atexit
+        return orig(self, idxs)
+
+    BlockKernelMatrix.block = dying
+
+est = KernelRidgeRegression(
+    gamma=0.1, lam=1.0, block_size=40, num_epochs=2, block_permuter=5,
+    checkpoint_dir=ckpt_dir, checkpoint_interval=1,
+)
+model = est.fit(Dataset.of(X), Dataset.of(Y))
+np.savez(out_file, W=np.asarray(model.W))
+"""
+
+
+def _run_worker(tmp_path, ckpt_dir, out_file, kill_after):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, str(worker), str(ckpt_dir), str(out_file),
+         str(kill_after)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+def test_krr_survives_process_kill_and_resumes(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    # uninterrupted reference run (no checkpoint dir interference)
+    ref_out = tmp_path / "ref.npz"
+    r = _run_worker(tmp_path, tmp_path / "ckpt_ref", ref_out, kill_after=0)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # killed run: process dies hard mid-solve
+    out = tmp_path / "out.npz"
+    r = _run_worker(tmp_path, ckpt, out, kill_after=4)
+    assert r.returncode == 42, (r.returncode, r.stderr[-2000:])
+    assert not out.exists()  # it really died before finishing
+    assert (ckpt / "krr_state.npz").exists()  # durable state at death
+
+    # fresh process resumes from disk and completes
+    r = _run_worker(tmp_path, ckpt, out, kill_after=0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    W_res = np.load(out)["W"]
+    W_ref = np.load(ref_out)["W"]
+    np.testing.assert_allclose(W_res, W_ref, rtol=1e-4, atol=1e-5)
+    # completed fit removes the restart state
+    assert not (ckpt / "krr_state.npz").exists()

@@ -1,0 +1,54 @@
+"""Observability configuration (utils/obs.py + CLI --logLevel/--profile)."""
+
+import logging
+
+from keystone_tpu.utils import obs, timing
+
+
+def test_configure_sets_level_and_format(capsys):
+    obs.configure("info")
+    logging.getLogger("keystone_tpu.test").info("hello obs")
+    err = capsys.readouterr().err
+    assert "hello obs" in err
+    assert "keystone_tpu.test" in err
+    obs.configure("warning")
+    logging.getLogger("keystone_tpu.test").info("hidden")
+    assert "hidden" not in capsys.readouterr().err
+
+
+def test_configure_rejects_unknown_level():
+    import pytest
+
+    with pytest.raises(ValueError):
+        obs.configure("loud")
+
+
+def test_profile_enables_phase_logs(capsys):
+    obs.configure("warning", profile=True)
+    try:
+        timing.reset()
+        with timing.phase("obs.test_phase"):
+            pass
+        snap = timing.snapshot()
+        assert "obs.test_phase" in snap
+        assert "obs.test_phase" in capsys.readouterr().err
+    finally:
+        obs.configure("warning", profile=False)
+
+
+def test_profile_env_parsing(monkeypatch):
+    for raw, want in [("1", True), ("true", True), ("0", False),
+                      ("false", False), ("", False), ("off", False)]:
+        monkeypatch.setenv("KEYSTONE_PROFILE", raw)
+        obs.configure("warning", profile=None)
+        assert timing._profiling is want, (raw, want)
+    monkeypatch.delenv("KEYSTONE_PROFILE")
+    obs.configure("warning", profile=False)
+
+
+def test_bad_env_level_falls_back(monkeypatch, capsys):
+    monkeypatch.setenv("KEYSTONE_LOG", "trace")
+    obs.configure(None)  # must not raise
+    import logging
+
+    assert logging.getLogger().level == logging.WARNING
