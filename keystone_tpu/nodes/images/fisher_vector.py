@@ -84,6 +84,8 @@ class GMMFisherVectorEstimator(Estimator):
         self.gmm_kwargs = gmm_kwargs
 
     def fit(self, data: Dataset) -> FisherVector:
+        from ...utils.timing import phase
+
         data = Dataset.of(data)
         if data.is_batched:
             X = jnp.asarray(data.to_array())
@@ -94,7 +96,9 @@ class GMMFisherVectorEstimator(Estimator):
             cols = jnp.asarray(
                 np.concatenate([np.asarray(i).T for i in data], axis=0)
             )
-        gmm = GaussianMixtureModelEstimator(
-            self.k, **self.gmm_kwargs
-        ).fit_matrix(cols)
+        with phase("gmm_fv.em_fit") as out:
+            gmm = GaussianMixtureModelEstimator(
+                self.k, **self.gmm_kwargs
+            ).fit_matrix(cols)
+            out.append(gmm.means)
         return FisherVector(gmm)

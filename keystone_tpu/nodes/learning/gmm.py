@@ -13,6 +13,7 @@ is subsumed: this on-device implementation IS the fast path.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Tuple
 
@@ -89,6 +90,54 @@ def _m_step(X, q, var_floor):
     variances = (q.T @ (X * X)) / q_sum[:, None] - means * means
     variances = jnp.maximum(variances, var_floor)
     return weights, means, variances, q_sum
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_iterations", "weight_threshold",
+                     "stop_tolerance", "min_cluster_size"),
+)
+def _em_loop(X, means, variances, weights, var_floor, *,
+             max_iterations: int, weight_threshold: float,
+             stop_tolerance: float, min_cluster_size: int):
+    """The whole EM iteration as ONE device program (lax.while_loop).
+
+    The eager loop paid two host round-trips per iteration (the f32 cost
+    scalar for the convergence test, the q_sum min-cluster check); through
+    a tunneled transport that dominated GMM fitting. Break semantics match
+    the reference loop exactly (GaussianMixtureModelEstimator.scala:
+    118-165): stop on non-improving cost or an unbalanced cluster, in both
+    cases KEEPING the previous iteration's parameters."""
+
+    def cond(carry):
+        i, done, *_ = carry
+        return (i < max_iterations) & ~done
+
+    def body(carry):
+        i, done, prev_cost, has_prev, m, v, w = carry
+        cost, q = _e_step(X, m, v, w, weight_threshold)
+        stop_conv = has_prev & ~(
+            cost - prev_cost >= stop_tolerance * jnp.abs(prev_cost)
+        )
+        new_w, new_m, new_v, q_sum = _m_step(X, q, var_floor)
+        unbalanced = jnp.any(q_sum < min_cluster_size)
+        advance = ~stop_conv & ~unbalanced
+        m2 = jnp.where(advance, new_m, m)
+        v2 = jnp.where(advance, new_v, v)
+        w2 = jnp.where(advance, new_w, w)
+        return (i + 1, stop_conv | unbalanced, cost, True, m2, v2, w2)
+
+    init = (
+        jnp.int32(0),
+        jnp.bool_(False),
+        jnp.float32(0.0),
+        jnp.bool_(False),
+        means,
+        variances,
+        weights,
+    )
+    _, _, _, _, m, v, w = jax.lax.while_loop(cond, body, init)
+    return m, v, w
 
 
 class GaussianMixtureModel(Transformer):
@@ -184,22 +233,13 @@ class GaussianMixtureModelEstimator(Estimator):
         )
         variances = jnp.maximum(variances, var_floor)
 
-        prev_cost = None
-        for _ in range(self.max_iterations):
-            cost_dev, q = _e_step(
-                X, means, variances, weights, self.weight_threshold
-            )
-            cost = float(cost_dev)
-            if prev_cost is not None and not (
-                cost - prev_cost >= self.stop_tolerance * abs(prev_cost)
-            ):
-                break
-            prev_cost = cost
-            new_w, new_m, new_v, q_sum = _m_step(X, q, var_floor)
-            if bool(jnp.any(q_sum < self.min_cluster_size)):
-                # parity: "Unbalanced clustering, try less centers"
-                break
-            weights, means, variances = new_w, new_m, new_v
+        means, variances, weights = _em_loop(
+            X, means, variances, weights, var_floor,
+            max_iterations=self.max_iterations,
+            weight_threshold=self.weight_threshold,
+            stop_tolerance=self.stop_tolerance,
+            min_cluster_size=self.min_cluster_size,
+        )
 
         return GaussianMixtureModel(
             means.T, variances.T, weights, self.weight_threshold

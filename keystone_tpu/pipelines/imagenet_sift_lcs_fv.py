@@ -105,6 +105,8 @@ def compute_pca_fisher_branch(
 ) -> Pipeline:
     """PCA + FV tail over a descriptor-extracting prefix
     (parity: computePCAandFisherBranch, ImageNetSiftLcsFV.scala:22-74)."""
+    from ..utils.timing import phase
+
     if pca_file:
         pca_mat = np.loadtxt(pca_file, delimiter=",", ndmin=2).T
         pca_featurizer = prefix.and_then(
@@ -112,9 +114,10 @@ def compute_pca_fisher_branch(
         )
     else:
         sampler = ColumnSampler(num_col_samples_per_image, seed=seed).to_pipeline()
-        pca = ColumnPCAEstimator(desc_dim).with_data(
-            sampler(prefix(train_images).get()).get()
-        )
+        with phase("imagenet.descriptors+pca_sample") as out:
+            pca_sample = sampler(prefix(train_images).get()).get()
+            out.append(pca_sample.to_array())
+        pca = ColumnPCAEstimator(desc_dim).with_data(pca_sample)
         pca_featurizer = prefix.and_then(pca)
 
     if gmm_mean_file:
@@ -127,9 +130,12 @@ def compute_pca_fisher_branch(
         sampler = ColumnSampler(
             gmm_samples_per_image or num_col_samples_per_image, seed=seed + 1
         ).to_pipeline()
+        with phase("imagenet.pca_fit+gmm_sample") as out:
+            gmm_sample = sampler(pca_featurizer(train_images).get()).get()
+            out.append(gmm_sample.to_array())
         fv = GMMFisherVectorEstimator(
             vocab_size, max_iterations=20, min_cluster_size=1
-        ).with_data(sampler(pca_featurizer(train_images).get()).get())
+        ).with_data(gmm_sample)
         fisher = pca_featurizer.and_then(fv)
 
     # FloatToDouble is identity here: the FV tail stays f32 on TPU (the
