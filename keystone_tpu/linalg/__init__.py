@@ -4,7 +4,9 @@ build.sbt:45)."""
 
 from .row_matrix import RowShardedMatrix, cross, gram, solve_spd
 from .normal_equations import (
+    gram_accumulate,
     solve_least_squares,
+    solve_least_squares_streaming,
     solve_least_squares_with_intercept,
 )
 from .bcd import solve_blockwise_l2, solve_blockwise_l2_scan
@@ -16,6 +18,8 @@ __all__ = [
     "cross",
     "solve_spd",
     "solve_least_squares",
+    "solve_least_squares_streaming",
+    "gram_accumulate",
     "solve_least_squares_with_intercept",
     "solve_blockwise_l2",
     "solve_blockwise_l2_scan",

@@ -26,16 +26,22 @@ from ..parallel.mesh import default_mesh, shard_batch
 def gram(A: jax.Array, dtype=None) -> jax.Array:
     """AᵀA. With A row-sharded, XLA lowers this to per-shard GEMM + psum over
     ICI — the reference's map+treeReduce Gram pattern
-    (BlockWeightedLeastSquares.scala:212-225) with the tree left to XLA."""
+    (BlockWeightedLeastSquares.scala:212-225) with the tree left to XLA.
+    Runs at solver precision (see linalg/bcd.py SOLVER_PRECISION): single-pass
+    bf16 Gram fails the float64-agreement bar."""
+    from .bcd import _mm
+
     if dtype is not None:
         A = A.astype(dtype)
-    return A.T @ A
+    return _mm(A.T, A)
 
 
 @jax.jit
 def cross(A: jax.Array, B: jax.Array) -> jax.Array:
-    """AᵀB with both row-sharded: per-shard GEMM + psum."""
-    return A.T @ B
+    """AᵀB with both row-sharded: per-shard GEMM + psum (solver precision)."""
+    from .bcd import _mm
+
+    return _mm(A.T, B)
 
 
 def solve_spd(G: jax.Array, rhs: jax.Array, reg: float = 0.0) -> jax.Array:

@@ -142,7 +142,17 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
 
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
         """``data`` is either a Dataset of (n, d) features (split internally,
-        parity :251-257) or an already-split sequence of blocks (:212)."""
+        parity :251-257) or an already-split sequence of blocks (:212).
+
+        A contiguous (n, d) matrix with d divisible by ``block_size`` solves
+        through :func:`solve_blockwise_l2_scan` — the whole BCD pass is ONE
+        compiled program (zero host round trips per block). Pre-split or
+        ragged blocks take the per-block-dispatch path.
+        """
+        from ...linalg.bcd import _block_means, solve_blockwise_l2_scan
+        from ...utils.timing import phase
+
+        X = None
         if isinstance(data, Dataset) and isinstance(data.payload, (list, tuple)):
             blocks = [jnp.asarray(p) for p in data.payload]
         elif isinstance(data, (list, tuple)):
@@ -150,15 +160,45 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
         else:
             X = Dataset.of(data).to_array()
             d = self.num_features or X.shape[-1]
+            X = X[..., :d]
+            blocks = None
+
+        y = Dataset.of(labels).to_array().astype(jnp.float32)
+
+        if X is not None and X.shape[-1] % self.block_size == 0:
+            d = X.shape[-1]
+            with phase("block_ls.center") as out:
+                X = shard_batch(
+                    X if X.dtype == jnp.float32 else X.astype(jnp.float32)
+                )
+                mean_vec = jnp.mean(X, axis=0)
+                y_mean = jnp.mean(y, axis=0)
+                out.append((mean_vec, y_mean))
+            with phase("block_ls.solve") as out:
+                W = solve_blockwise_l2_scan(
+                    X, shard_batch(y - y_mean), reg=self.lam,
+                    block_size=self.block_size, num_iter=self.num_iter,
+                    means=mean_vec,
+                )
+                out.append(W)
+            ws = [
+                W[i : i + self.block_size]
+                for i in range(0, d, self.block_size)
+            ]
+            means = [
+                mean_vec[i : i + self.block_size]
+                for i in range(0, d, self.block_size)
+            ]
+            return BlockLinearMapper(
+                ws, self.block_size, b=y_mean, feature_means=means
+            )
+
+        if blocks is None:
+            d = X.shape[-1]
             blocks = [
                 X[..., i : min(i + self.block_size, d)]
                 for i in range(0, d, self.block_size)
             ]
-        y = Dataset.of(labels).to_array().astype(jnp.float32)
-
-        from ...linalg.bcd import _block_means
-        from ...utils.timing import phase
-
         with phase("block_ls.center") as out:
             blocks = [
                 shard_batch(b if b.dtype == jnp.float32 else b.astype(jnp.float32))
