@@ -24,21 +24,10 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .row_matrix import solve_spd
-
-
-#: Matmul precision for every solver GEMM. TPU MXUs multiply in bf16;
-#: single-pass bf16 ("default") loses ~2e-3 relative accuracy vs float64 at
-#: reference solver shapes — enough to fail the 1e-3 float64-agreement bar
-#: (tests/linalg/test_solver_accuracy.py). "high" (bf16_3x decomposition)
-#: measures 1.3e-5 relative at d=8192 while sustaining ~35 Tf/s of the
-#: 98.5 Tf/s f32 peak on v5e. The reference solves in float64 Breeze;
-#: f32+high is the TPU-native accuracy/throughput point.
-SOLVER_PRECISION = "high"
-
-
-def _mm(a: jax.Array, b: jax.Array) -> jax.Array:
-    return jnp.matmul(a, b, precision=SOLVER_PRECISION)
+# SOLVER_PRECISION and _mm live in row_matrix (the bottom of the linalg
+# stack); re-exported here because bcd is where the precision decision is
+# most visible to solver readers.
+from .row_matrix import SOLVER_PRECISION, _mm, solve_spd  # noqa: F401
 
 
 def _block_update_impl(

@@ -16,8 +16,8 @@ from .row_matrix import solve_spd
 
 
 # Solver GEMMs run at SOLVER_PRECISION (bf16_3x): single-pass bf16 fails the
-# float64-agreement bar at reference shapes — see linalg/bcd.py.
-from .bcd import _mm
+# float64-agreement bar at reference shapes — see linalg/row_matrix.py.
+from .row_matrix import _mm
 
 
 @jax.jit

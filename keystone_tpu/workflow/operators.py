@@ -21,7 +21,8 @@ from .expressions import (
 
 class Operator:
     """Base of all graph operators. Identity-based equality (two separately
-    constructed operators are distinct nodes even with equal parameters)."""
+    constructed operators are distinct nodes even with equal parameters);
+    the CSE rule merges structurally-equal ones via :func:`structural_key`."""
 
     @property
     def label(self) -> str:
@@ -29,6 +30,57 @@ class Operator:
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         raise NotImplementedError
+
+
+class _Uncanonical(Exception):
+    """Raised when an operator's state has no content-based canonical form."""
+
+
+def _canon(v):
+    """Canonicalize one parameter value into a hashable content digest."""
+    import numpy as np
+
+    if v is None or isinstance(v, (bool, int, float, complex, str, bytes)):
+        return v
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        import hashlib
+
+        return (
+            "ndarray", v.shape, str(v.dtype),
+            hashlib.sha1(np.ascontiguousarray(v).tobytes()).hexdigest(),
+        )
+    if isinstance(v, (list, tuple)):
+        return (type(v).__name__, tuple(_canon(x) for x in v))
+    if isinstance(v, dict):
+        return ("dict", tuple(sorted((k, _canon(x)) for k, x in v.items())))
+    if isinstance(v, (set, frozenset)):
+        return ("set", tuple(sorted(map(repr, v))))
+    # Callables, datasets, device arrays, arbitrary objects: two separately
+    # constructed values cannot be proven equal — bail to identity.
+    raise _Uncanonical(type(v).__name__)
+
+
+def structural_key(op: "Operator"):
+    """Content-based identity for CSE (parity: the reference's Scala case
+    classes give ``EquivalentNodeMergeRule.scala:13`` structural equality
+    for free — two separately-constructed equal nodes merge).
+
+    Returns ``(type, canonical-params)`` when every attribute of the
+    operator canonicalizes (scalars, strings, tuples, numpy arrays by
+    content digest — ``utils/params.py`` keeps fitted parameters as numpy,
+    so fitted transformers canonicalize too). Operators defining their own
+    ``__eq__`` (Dataset/Datum leaves) and operators holding closures or
+    arbitrary objects fall back to the operator instance itself, i.e.
+    object identity — conservative, never merges wrongly."""
+    cls = type(op)
+    if cls.__eq__ is not object.__eq__:
+        return op  # operator defines its own (payload-identity) equality
+    try:
+        return (cls, _canon(vars(op)))
+    except _Uncanonical:
+        return op
 
 
 class Cacheable:

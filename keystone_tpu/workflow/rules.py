@@ -148,15 +148,32 @@ class UnusedBranchRemovalRule(Rule):
 
 
 class EquivalentNodeMergeRule(Rule):
-    """Common-subexpression elimination: merge nodes with the identical
-    operator (object identity) and identical dependencies, to fixpoint
-    (parity: ``EquivalentNodeMergeRule.scala``)."""
+    """Common-subexpression elimination: merge nodes with structurally
+    equal operators and identical dependencies, to fixpoint (parity:
+    ``EquivalentNodeMergeRule.scala:13`` — Scala case-class equality merges
+    separately-constructed equal nodes; :func:`structural_key` recovers
+    that here, falling back to object identity for uncanonicalizable
+    state such as closures)."""
 
     def apply(self, graph: Graph, annotations: Annotations) -> Tuple[Graph, Annotations]:
+        from .operators import structural_key
+
+        # Merging only rewires dependencies — operator keys never change
+        # within one apply(), so memoize the (sha1-of-params) key per
+        # operator instance across fixpoint passes.
+        key_cache: Dict[int, object] = {}
+
+        def op_key(op):
+            k = key_cache.get(id(op))
+            if k is None:
+                k = key_cache[id(op)] = structural_key(op)
+            return k
+
         while True:
             groups: Dict[Tuple, List[NodeId]] = {}
             for node in graph.nodes:
-                key = (graph.get_operator(node), tuple(graph.get_dependencies(node)))
+                key = (op_key(graph.get_operator(node)),
+                       tuple(graph.get_dependencies(node)))
                 groups.setdefault(key, []).append(node)
             dups = {k: sorted(v) for k, v in groups.items() if len(v) > 1}
             if not dups:
