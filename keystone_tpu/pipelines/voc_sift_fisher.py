@@ -99,6 +99,9 @@ def run(train_images, train_label_sets, test_images, test_label_sets,
             conf.gmm_mean_file, conf.gmm_var_file, conf.gmm_wts_file
         )
         fisher = pca_featurizer.and_then(FisherVector(gmm))
+        # a loaded codebook sets the FV width (e.g. the real VOC codebook
+        # is 256 centers, not the config default)
+        vocab_size = int(gmm.k)
     else:
         per_img = max(1, conf.num_gmm_samples // n_train)
         sampler = ColumnSampler(per_img, seed=conf.seed + 1).to_pipeline()
@@ -106,6 +109,7 @@ def run(train_images, train_label_sets, test_images, test_label_sets,
             conf.vocab_size, max_iterations=20, min_cluster_size=1
         ).with_data(sampler(pca_featurizer(train_images).get()).get())
         fisher = pca_featurizer.and_then(fv)
+        vocab_size = conf.vocab_size
 
     fisher_featurizer = (
         fisher
@@ -119,7 +123,7 @@ def run(train_images, train_label_sets, test_images, test_label_sets,
     predictor = fisher_featurizer.and_then(
         BlockLeastSquaresEstimator(
             4096, 1, conf.lam,
-            num_features=2 * conf.desc_dim * conf.vocab_size,
+            num_features=2 * conf.desc_dim * vocab_size,
         ),
         train_images,
         labels,
