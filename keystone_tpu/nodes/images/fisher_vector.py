@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
+from ...utils.jit import nestable_jit
 from ..learning.gmm import (
     GaussianMixtureModel,
     GaussianMixtureModelEstimator,
@@ -27,7 +28,7 @@ from ..learning.gmm import (
 )
 
 
-@jax.jit
+@nestable_jit
 def _fisher_vector(X, means, variances, weights, weight_threshold):
     """X: (n, d, m) batch of descriptor matrices; means/variances (d, k);
     weights (k,). Returns (n, d, 2k)."""
@@ -40,8 +41,11 @@ def _fisher_vector(X, means, variances, weights, weight_threshold):
         )
     )(Xt)
     s0 = jnp.mean(q, axis=1)                       # (n, k)
-    s1 = jnp.einsum("ndm,nmk->ndk", X, q) / n_desc  # (n, d, k)
-    s2 = jnp.einsum("ndm,nmk->ndk", X * X, q) / n_desc
+    # precision=high like the GMM contractions (see gmm.py _PREC): the fv2
+    # term subtracts products of these statistics, so bf16 GEMM noise there
+    # is visible after the ±cancellation
+    s1 = jnp.einsum("ndm,nmk->ndk", X, q, precision="high") / n_desc
+    s2 = jnp.einsum("ndm,nmk->ndk", X * X, q, precision="high") / n_desc
 
     fv1 = (s1 - means * s0[:, None, :]) / (
         jnp.sqrt(variances) * jnp.sqrt(weights)

@@ -24,13 +24,25 @@ import numpy as np
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
 from ...utils.params import as_param
+from ...utils.jit import nestable_jit
 from .kmeans import KMeansPlusPlusEstimator
 
 KMEANS_PLUS_PLUS_INITIALIZATION = "kmeans++"
 RANDOM_INITIALIZATION = "random"
 
 
-@jax.jit
+# The Mahalanobis term is an expanded quadratic (‖x‖²/σ² − 2xμ/σ² + ‖μ‖²/σ²
+# as GEMMs — the TPU-right shape), which cancels catastrophically: at
+# single-pass-bf16 matmul precision the residual error (~4e-3 of the large
+# terms) lands in the exponent of the posterior softmax and flips
+# assignments depending on how XLA fused the surrounding program (observed:
+# the SAME FisherVector inputs gave posteriors differing by O(1) inside vs
+# outside a whole-chain jit). precision=high keeps the cancellation at f32
+# noise, making the encoding fusion-invariant.
+_PREC = "high"
+
+
+@nestable_jit
 def _posteriors(X, means, variances, weights, weight_threshold):
     """Thresholded posterior assignments q (n, k)
     (parity: GaussianMixtureModel.apply:47-82). means/variances here are
@@ -38,8 +50,8 @@ def _posteriors(X, means, variances, weights, weight_threshold):
     Xsq = X * X
     half_inv_var = 0.5 / variances
     sq_mahal = (
-        Xsq @ half_inv_var.T
-        - X @ (means / variances).T
+        jnp.matmul(Xsq, half_inv_var.T, precision=_PREC)
+        - jnp.matmul(X, (means / variances).T, precision=_PREC)
         + 0.5 * jnp.sum(means * means / variances, axis=1)
     )
     d = X.shape[1]
@@ -63,8 +75,8 @@ def _e_step(X, means, variances, weights, weight_threshold):
     llh for both too (GaussianMixtureModelEstimator.scala:118-165)."""
     Xsq = X * X
     sq_mahal = (
-        Xsq @ (0.5 / variances).T
-        - X @ (means / variances).T
+        jnp.matmul(Xsq, (0.5 / variances).T, precision=_PREC)
+        - jnp.matmul(X, (means / variances).T, precision=_PREC)
         + 0.5 * jnp.sum(means * means / variances, axis=1)
     )
     d = X.shape[1]
@@ -86,8 +98,11 @@ def _e_step(X, means, variances, weights, weight_threshold):
 def _m_step(X, q, var_floor):
     q_sum = jnp.sum(q, axis=0)
     weights = q_sum / X.shape[0]
-    means = (q.T @ X) / q_sum[:, None]
-    variances = (q.T @ (X * X)) / q_sum[:, None] - means * means
+    means = jnp.matmul(q.T, X, precision=_PREC) / q_sum[:, None]
+    variances = (
+        jnp.matmul(q.T, X * X, precision=_PREC) / q_sum[:, None]
+        - means * means
+    )
     variances = jnp.maximum(variances, var_floor)
     return weights, means, variances, q_sum
 

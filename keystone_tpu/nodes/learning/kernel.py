@@ -23,10 +23,11 @@ import numpy as np
 
 from ...data.dataset import Dataset
 from ...utils.timing import phase
+from ...utils.jit import nestable_jit
 from ...workflow.transformer import LabelEstimator, Transformer
 
 
-@jax.jit
+@nestable_jit
 def _gaussian_block_xla(X, Xb, gamma):
     """exp(−γ‖x−y‖²) for all (row of X, row of Xb): (n, b)
     (parity: computeKernel, KernelGenerator.scala:138-206)."""

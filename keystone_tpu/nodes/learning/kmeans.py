@@ -15,9 +15,10 @@ import numpy as np
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
 from ...utils.params import as_param
+from ...utils.jit import nestable_jit
 
 
-@jax.jit
+@nestable_jit
 def _sq_dists(X, means):
     """½‖x‖² − x·μ + ½‖μ‖² per (sample, center) — the reference's vectorized
     distance trick (KMeansPlusPlus.scala:34-39)."""
@@ -26,7 +27,7 @@ def _sq_dists(X, means):
     return xsq - X @ means.T + msq
 
 
-@jax.jit
+@nestable_jit
 def _one_hot_assign(X, means):
     d = _sq_dists(X, means)
     idx = jnp.argmin(d, axis=1)

@@ -204,7 +204,13 @@ class Sampler(Transformer):
 class ColumnSampler(Transformer):
     """Sample ``num_samples`` random columns of each (d, m) matrix item
     (parity: Sampling.scala:12-20). Used to subsample descriptor matrices
-    before PCA/GMM estimation."""
+    before PCA/GMM estimation.
+
+    A batched (n, d, m) descriptor stack samples in ONE device gather
+    (take_along_axis with per-item column draws) instead of n per-item
+    dispatches — through a tunneled transport the per-item loop was the
+    dominant cost of the ImageNet fit's sampling phases (round 3: ~50 s
+    per branch at 300 images for ~0.1 s of gather work)."""
 
     def __init__(self, num_samples_per_matrix: int, seed: int = 0):
         self.num_samples = num_samples_per_matrix
@@ -215,3 +221,15 @@ class ColumnSampler(Transformer):
         x = jnp.asarray(x)
         cols = self._rng.integers(0, x.shape[1], size=self.num_samples)
         return x[:, jnp.asarray(cols)]
+
+    def apply_batch(self, data):
+        data = Dataset.of(data)
+        if not data.is_batched:
+            return data.map(self.apply)
+        X = data.to_array()  # (n, d, m), device-resident
+        n, _, m = X.shape
+        cols = self._rng.integers(0, m, size=(n, self.num_samples))
+        out = jnp.take_along_axis(
+            X, jnp.asarray(cols)[:, None, :], axis=2
+        )
+        return Dataset(out, batched=True)

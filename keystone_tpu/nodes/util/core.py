@@ -118,10 +118,17 @@ class VectorSplitter(Transformer):
 
 class Cacher(Transformer):
     """Materialize and hold the upstream result (parity: Cacher.scala:15 —
-    the node the AutoCacheRule inserts). On TPU this pins the array in HBM."""
+    the node the AutoCacheRule inserts). On TPU this pins the array in HBM.
+
+    Inside a fused traced program (FittedPipeline.trace_fn) caching is
+    meaningless — XLA holds intermediates — so the traced form is identity;
+    this keeps serve chains containing Cachers one-jaxpr compilable."""
 
     def __init__(self, name: Optional[str] = None):
         self.name = name
+
+    def trace_batch(self, X):
+        return X
 
     def apply(self, x):
         return x

@@ -109,29 +109,42 @@ def compute_pca_fisher_branch(
 
     if pca_file:
         pca_mat = np.loadtxt(pca_file, delimiter=",", ndmin=2).T
-        pca_featurizer = prefix.and_then(
-            BatchPCATransformer(jnp.asarray(pca_mat, dtype=jnp.float32))
-        )
+        # a loaded PCA matrix sets this branch's descriptor dim
+        desc_dim = int(pca_mat.shape[1])
+        # to_pipeline() so both PCA sources expose the same Pipeline
+        # interface to the GMM-sample site below
+        pca_apply = BatchPCATransformer(
+            jnp.asarray(pca_mat, dtype=jnp.float32)
+        ).to_pipeline()
+        pca_featurizer = prefix.and_then(pca_apply)
     else:
         sampler = ColumnSampler(num_col_samples_per_image, seed=seed).to_pipeline()
         with phase("imagenet.descriptors+pca_sample") as out:
             pca_sample = sampler(prefix(train_images).get()).get()
             out.append(pca_sample.to_array())
-        pca = ColumnPCAEstimator(desc_dim).with_data(pca_sample)
-        pca_featurizer = prefix.and_then(pca)
+        pca_apply = ColumnPCAEstimator(desc_dim).with_data(pca_sample)
+        pca_featurizer = prefix.and_then(pca_apply)
 
     if gmm_mean_file:
         gmm = GaussianMixtureModel.load(gmm_mean_file, gmm_var_file, gmm_wts_file)
         fisher = pca_featurizer.and_then(FisherVector(gmm))
+        # a loaded codebook sets this branch's FV width (see voc_sift_fisher)
+        vocab_size = int(gmm.k)
     else:
         # The reference derives BOTH samplers from numPcaSamples and leaves
         # numGmmSamples unused (ImageNetSiftLcsFV.scala:108,146-167); here
-        # the GMM sample budget is honored when given.
+        # the GMM sample budget is honored when given. TPU-first reorder:
+        # the reference samples AFTER projecting the full descriptor set
+        # (sampler(pcaFeaturizer(data))); the PCA projection is per-column,
+        # so sampling first is distributionally identical and skips ~15× of
+        # projection work (only sampled columns project). The cached prefix
+        # output is reused from the PCA phase.
         sampler = ColumnSampler(
             gmm_samples_per_image or num_col_samples_per_image, seed=seed + 1
         ).to_pipeline()
         with phase("imagenet.pca_fit+gmm_sample") as out:
-            gmm_sample = sampler(pca_featurizer(train_images).get()).get()
+            desc_sample = sampler(prefix(train_images).get()).get()
+            gmm_sample = pca_apply(desc_sample).get()
             out.append(gmm_sample.to_array())
         fv = GMMFisherVectorEstimator(
             vocab_size, max_iterations=20, min_cluster_size=1
@@ -140,12 +153,13 @@ def compute_pca_fisher_branch(
 
     # FloatToDouble is identity here: the FV tail stays f32 on TPU (the
     # reference widens for its f64 Breeze solver, ImageNetSiftLcsFV.scala:69).
-    return (
+    branch = (
         fisher.and_then(MatrixVectorizer())
         .and_then(NormalizeRows())
         .and_then(SignedHellingerMapper())
         .and_then(NormalizeRows())
     )
+    return branch, 2 * desc_dim * vocab_size
 
 
 def build_predictor(train_images, train_int_labels, conf: ImageNetSiftLcsFVConfig):
@@ -164,7 +178,7 @@ def build_predictor(train_images, train_int_labels, conf: ImageNetSiftLcsFVConfi
         .and_then(SignedHellingerMapper())  # BatchSignedHellingerMapper
         .and_then(Cacher())
     )
-    sift_branch = compute_pca_fisher_branch(
+    sift_branch, sift_width = compute_pca_fisher_branch(
         sift_prefix,
         train_images,
         num_col_samples_per_image=per_img,
@@ -181,7 +195,7 @@ def build_predictor(train_images, train_int_labels, conf: ImageNetSiftLcsFVConfi
     lcs_prefix = LCSExtractor(
         conf.lcs_stride, conf.lcs_border, conf.lcs_patch
     ).to_pipeline().and_then(Cacher())
-    lcs_branch = compute_pca_fisher_branch(
+    lcs_branch, lcs_width = compute_pca_fisher_branch(
         lcs_prefix,
         train_images,
         num_col_samples_per_image=per_img,
@@ -209,7 +223,9 @@ def build_predictor(train_images, train_int_labels, conf: ImageNetSiftLcsFVConfi
                 1,
                 conf.lam,
                 conf.mixture_weight,
-                num_features=2 * 2 * conf.desc_dim * conf.vocab_size,
+                # per-branch widths: loaded PCA/GMM checkpoints may differ
+                # from the config's desc_dim/vocab_size
+                num_features=sift_width + lcs_width,
             ),
             train_images,
             labels,

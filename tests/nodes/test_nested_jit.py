@@ -1,0 +1,88 @@
+"""Nested-jit safety (utils/jit.py).
+
+On the tunneled TPU backend this repo targets, a ``jax.jit``-decorated
+helper CALLED INSIDE another jitted computation was observed to miscompile:
+GMM posteriors from `_posteriors` flipped 0↔1 (an 18-llh-unit error against
+a float64 oracle) when nested, while the same body inlined — or the
+decorated function called at top level — was correct to f32 noise. The
+pipeline-visible symptom: `jax.jit(fitted.trace_fn())` predicted different
+labels than the eager executor on identical inputs.
+
+``nestable_jit`` inlines the body when already tracing. These tests pin the
+agreement contract on every backend (the CPU test backend never had the
+bug, but the contract — traced == eager == f64 oracle — must hold
+everywhere).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.nodes.learning.gmm import _posteriors
+from keystone_tpu.utils.jit import nestable_jit
+
+
+def _fixture(m=512, d=8, k=2, seed=0):
+    rng = np.random.default_rng(seed)
+    X = (rng.standard_normal((m, d)) * 5).astype(np.float32)
+    # give one descriptor a large-magnitude coordinate like real PCA'd SIFT
+    X[0, 0] = -36.6
+    means = rng.standard_normal((k, d)).astype(np.float32)
+    var = (2.0 * (1 + rng.random((k, d)))).astype(np.float32)
+    w = np.array([0.7, 0.3], dtype=np.float32)
+    return jnp.asarray(X), jnp.asarray(means), jnp.asarray(var), jnp.asarray(w)
+
+
+def test_nestable_jit_inlines_under_trace():
+    calls = {"n": 0}
+
+    def body(x):
+        calls["n"] += 1
+        return x * 2.0
+
+    f = nestable_jit(body)
+    x = jnp.ones((4,))
+    f(x)  # eager → jitted path traces body once
+    n_after_eager = calls["n"]
+    jax.jit(lambda x: f(x))(x)  # nested → body re-traced inline
+    assert calls["n"] == n_after_eager + 1
+
+
+def test_posteriors_agree_nested_vs_eager():
+    X, means, var, w = _fixture()
+    thr = 1e-4
+    q_eager = np.asarray(_posteriors(X, means, var, w, thr))
+    q_nested = np.asarray(
+        jax.jit(lambda x: _posteriors(x, means, var, w, thr))(X)
+    )
+    np.testing.assert_allclose(q_nested, q_eager, atol=1e-4)
+
+
+def test_posteriors_match_float64_oracle():
+    X, means, var, w = _fixture()
+    thr = 1e-4
+    x64 = np.asarray(X, dtype=np.float64)
+    m64 = np.asarray(means, dtype=np.float64)
+    v64 = np.asarray(var, dtype=np.float64)
+    w64 = np.asarray(w, dtype=np.float64)
+    ll = np.stack(
+        [
+            -0.5 * np.sum((x64 - m64[j]) ** 2 / v64[j], axis=1)
+            - 0.5 * np.sum(np.log(2 * np.pi * v64[j]))
+            + np.log(w64[j])
+            for j in range(len(w64))
+        ],
+        axis=1,
+    )
+    ll -= ll.max(axis=1, keepdims=True)
+    q = np.exp(ll)
+    q /= q.sum(axis=1, keepdims=True)
+    q = np.where(q > thr, q, 0.0)
+    q /= q.sum(axis=1, keepdims=True)
+
+    for q_got in (
+        np.asarray(_posteriors(X, means, var, w, thr)),
+        np.asarray(jax.jit(lambda x: _posteriors(x, means, var, w, thr))(X)),
+    ):
+        np.testing.assert_allclose(q_got, q, atol=1e-3)

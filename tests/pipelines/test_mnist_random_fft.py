@@ -17,11 +17,13 @@ from keystone_tpu.pipelines.mnist_random_fft import (
 
 
 def test_mnist_random_fft_end_to_end():
-    train, test = synthetic_mnist(n_train=1024, n_test=256, seed=7)
+    # The synthetic task has a calibrated ~4% Bayes error (overlapping
+    # classes — VERDICT r3 #2), so n_train must exceed the d=1024 feature
+    # dim for the test error to mean anything (at n=d the interpolating
+    # solve memorizes noise).
+    train, test = synthetic_mnist(n_train=4096, n_test=512, seed=7)
     conf = MnistRandomFFTConfig(num_ffts=2, block_size=512, lam=10.0)
     pipeline, train_err, test_err, seconds = run(train, test, conf)
-    # Synthetic classes are linearly separable-ish after FFT features; the
-    # pipeline must do far better than chance (90% error).
     assert train_err < 0.15, f"train error {train_err}"
     assert test_err < 0.35, f"test error {test_err}"
 
