@@ -68,7 +68,7 @@ def _posteriors(X, means, variances, weights, weight_threshold):
     return q / jnp.sum(q, axis=1, keepdims=True)
 
 
-@jax.jit
+@nestable_jit
 def _e_step(X, means, variances, weights, weight_threshold):
     """One fused E-step: (mean log-sum-exp likelihood, thresholded
     posteriors) from a single Mahalanobis computation — the reference reuses
@@ -94,7 +94,7 @@ def _e_step(X, means, variances, weights, weight_threshold):
     return cost, q / jnp.sum(q, axis=1, keepdims=True)
 
 
-@jax.jit
+@nestable_jit
 def _m_step(X, q, var_floor):
     q_sum = jnp.sum(q, axis=0)
     weights = q_sum / X.shape[0]
