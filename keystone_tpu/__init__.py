@@ -22,18 +22,22 @@ def _enable_persistent_compile_cache() -> None:
     cache_dir = _os.environ.get("KEYSTONE_COMPILE_CACHE") or _os.path.join(
         _os.path.expanduser("~"), ".cache", "keystone_tpu", "xla"
     )
-    try:
-        import jax
+    # NOTE: importing this package therefore imports jax and touches global
+    # jax.config as an import side effect — env vars like JAX_PLATFORMS set
+    # by user code AFTER `import keystone_tpu` will not take effect (see
+    # README "Backend selection"). Use parallel.virtual or __main__'s
+    # --backend flag to pick a backend programmatically.
+    import jax
 
-        if (
-            _os.environ.get("JAX_COMPILATION_CACHE_DIR")
-            or jax.config.jax_compilation_cache_dir
-        ):
-            return  # the user already configured a cache; don't hijack it
+    if _os.environ.get("JAX_COMPILATION_CACHE_DIR") or getattr(
+        jax.config, "jax_compilation_cache_dir", None
+    ):
+        return  # the user already configured a cache; don't hijack it
+    try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:  # pragma: no cover - ancient jax without the knobs
+    except Exception:  # pragma: no cover - jax without these specific knobs
         pass
 
 

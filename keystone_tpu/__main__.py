@@ -100,6 +100,13 @@ def _select_backend(backend: Optional[str], cpu_devices: int) -> None:
     """Pick the jax platform BEFORE any device is touched. A sitecustomize
     may have pre-imported jax, so env vars are too late — use the config
     knob / virtual-device provisioner instead."""
+    if cpu_devices > 1 and backend != "cpu":
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "--cpuDevices %d has no effect without --backend cpu "
+            "(virtual devices exist only on the cpu backend)", cpu_devices,
+        )
     if backend is None:
         return
     if backend == "cpu" and cpu_devices > 1:

@@ -138,11 +138,16 @@ def read_labels_map(labels_path: str) -> Dict[str, int]:
     """'<dirname> <int>' per line (parity: ImageNetLoader.scala:27-32)."""
     out: Dict[str, int] = {}
     with open(labels_path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             parts = line.split()  # any whitespace, tolerant of runs/tabs
+            if len(parts) < 2:
+                raise ValueError(
+                    f"{labels_path}:{lineno}: expected '<classdir> <int>', "
+                    f"got {line!r}"
+                )
             out[parts[0]] = int(parts[1])
     return out
 
@@ -177,14 +182,21 @@ def read_voc_labels(labels_path: str) -> Dict[str, List[int]]:
     """VOC label CSV: header row, columns where parts[4] is the quoted file
     name and parts[1] the 1-indexed class (parity: VOCLoader.scala:33-48;
     a file appears once per object instance → multi-label)."""
+    import csv
+
     out: Dict[str, List[int]] = {}
-    with open(labels_path) as f:
-        lines = f.read().splitlines()
-    for line in lines[1:]:
-        if not line.strip():
+    with open(labels_path, newline="") as f:
+        reader = csv.reader(f)  # honors quoted fields containing commas
+        rows = list(reader)
+    for lineno, parts in enumerate(rows[1:], 2):
+        if not parts or not any(p.strip() for p in parts):
             continue
-        parts = line.split(",")
-        fname = parts[4].replace('"', "")
+        if len(parts) < 5:
+            raise ValueError(
+                f"{labels_path}:{lineno}: expected >=5 CSV columns "
+                f"(VOCLoader format), got {len(parts)}"
+            )
+        fname = parts[4]
         label = int(parts[1]) - 1
         out.setdefault(fname, []).append(label)
     return out

@@ -21,6 +21,7 @@ from .lbfgs import (
 from .weighted import (
     BlockWeightedLeastSquaresEstimator,
     PerClassWeightedLeastSquaresEstimator,
+    ReWeightedLeastSquaresEstimator,
 )
 from .gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from .kmeans import KMeansModel, KMeansPlusPlusEstimator
@@ -60,6 +61,7 @@ __all__ = [
     "SparseLBFGSwithL2",
     "BlockWeightedLeastSquaresEstimator",
     "PerClassWeightedLeastSquaresEstimator",
+    "ReWeightedLeastSquaresEstimator",
     "GaussianMixtureModel",
     "GaussianMixtureModelEstimator",
     "KMeansModel",

@@ -47,39 +47,81 @@ _WORD_BITS = 20
 _MAX_VOCAB = 1 << _WORD_BITS
 
 
+def _sorted_vocab(vocab: Dict[str, int]):
+    """(sorted keys array, aligned ids) for the vectorized lookup; built
+    once per fitted vectorizer (the vocab is immutable after fit)."""
+    keys = np.asarray(list(vocab.keys()), dtype=str)
+    vals = np.asarray(list(vocab.values()), dtype=np.int64)
+    sort = np.argsort(keys)
+    return keys[sort], vals[sort]
+
+
 def _token_ids(
     docs: Sequence[Sequence[str]],
     vocab: Dict[str, int],
     grow: bool,
+    sorted_vocab=None,
 ) -> List[np.ndarray]:
-    """Map token-list docs to int32 id arrays. ``grow=True`` extends the
-    vocabulary (fit); otherwise unknown tokens become -1 (apply)."""
-    out = []
+    """Map token-list docs to int64 id arrays. ``grow=True`` extends the
+    vocabulary (fit); otherwise unknown tokens become -1 (apply).
+
+    Vectorized (VERDICT r3 #7): the per-token Python dict loop was the
+    text path's host tail. One ``np.concatenate`` over the corpus, one
+    ``np.unique``/``np.searchsorted`` in C, and a small lookup table —
+    with ids still assigned in FIRST-SEEN order over the concatenated
+    stream, bit-identical to the dict loop (selection tie-breaks depend
+    on id order, so this must not change)."""
+    lengths = [len(doc) for doc in docs]
+    total = sum(lengths)
+    if total == 0:
+        return [np.empty(0, dtype=np.int64) for _ in docs]
+    flat = np.concatenate([np.asarray(doc, dtype=object) for doc in docs])
+    flat = flat.astype(str)
     if grow:
-        get = vocab.get
-        for doc in docs:
-            arr = np.empty(len(doc), dtype=np.int64)
-            for i, t in enumerate(doc):
-                j = get(t)
-                if j is None:
-                    j = len(vocab)
-                    vocab[t] = j
-                arr[i] = j
-            out.append(arr)
-    else:
-        get = vocab.get
-        for doc in docs:
-            out.append(
-                np.fromiter(
-                    (get(t, -1) for t in doc), dtype=np.int64, count=len(doc)
-                )
+        # vocab may already hold entries (not in practice, but keep the
+        # dict-API contract): seed the unique pass with existing order
+        base = len(vocab)
+        uniq, first_idx, inv = np.unique(
+            flat, return_index=True, return_inverse=True
+        )
+        known = (
+            np.fromiter(
+                (vocab.get(t, -1) for t in uniq), dtype=np.int64,
+                count=len(uniq),
             )
+            if base
+            else np.full(len(uniq), -1, dtype=np.int64)
+        )
+        # new tokens get ids by first appearance in the stream
+        new_mask = known < 0
+        order = np.argsort(first_idx[new_mask], kind="stable")
+        lut = known.copy()
+        new_ids = np.empty(int(new_mask.sum()), dtype=np.int64)
+        new_ids[order] = base + np.arange(len(new_ids))
+        lut[new_mask] = new_ids
+        for t, j in zip(uniq[new_mask], lut[new_mask]):
+            vocab[str(t)] = int(j)
+        ids_flat = lut[inv]
+    else:
+        if not vocab:
+            ids_flat = np.full(total, -1, dtype=np.int64)
+        else:
+            keys, vals = (
+                sorted_vocab
+                if sorted_vocab is not None
+                else _sorted_vocab(vocab)
+            )
+            pos = np.searchsorted(keys, flat)
+            pos = np.clip(pos, 0, len(keys) - 1)
+            hit = keys[pos] == flat
+            ids_flat = np.where(hit, vals[pos], -1)
     if len(vocab) > _MAX_VOCAB:
         raise ValueError(
             f"vocabulary {len(vocab)} exceeds the 2^{_WORD_BITS} packed-id "
             "limit; use the composed NGramsFeaturizer chain"
         )
-    return out
+    splits = np.cumsum(lengths)[:-1]
+    return [a for a in np.split(ids_flat, splits)]
 
 
 def _corpus_grams(
@@ -201,18 +243,34 @@ class PackedTextVectorizer(Transformer):
         self.columns = columns    # column id per selected gram
         self.orders = list(orders)
         self.tf_fun = tf_fun
+        #: (payload object, per-doc gram stream) handed over by fit so
+        #: applying to the training set skips re-tokenizing/re-gramming.
+        #: A STRONG reference compared with ``is`` — an id() key could be
+        #: reused after GC and silently serve another dataset's grams.
+        #: Consumed (cleared) on its one hit; dropped on pickle.
+        self._train_cache = None
+        #: lazily-built (sorted keys, ids) for the vectorized OOV lookup
+        self._sorted_vocab = None
 
     @property
     def num_features(self) -> int:
         return len(self.selected)
 
-    def _match(self, docs) -> tuple:
+    def _match(self, docs, precomputed=None) -> tuple:
         """Flat (doc_ids, columns, tf_values) for every selected gram in
         ``docs``, doc-major."""
-        ids = _token_ids(docs, self.vocab, grow=False)
-        d_u, g_u, counts = _per_doc_unique(
-            *_corpus_grams(ids, self.orders)
-        )
+        if precomputed is not None:
+            d_u, g_u, counts = precomputed
+        else:
+            if self._sorted_vocab is None and self.vocab:
+                self._sorted_vocab = _sorted_vocab(self.vocab)
+            ids = _token_ids(
+                docs, self.vocab, grow=False,
+                sorted_vocab=self._sorted_vocab,
+            )
+            d_u, g_u, counts = _per_doc_unique(
+                *_corpus_grams(ids, self.orders)
+            )
         pos = np.searchsorted(self.selected, g_u)
         pos = np.clip(pos, 0, max(len(self.selected) - 1, 0))
         keep = (
@@ -223,8 +281,8 @@ class PackedTextVectorizer(Transformer):
         values = _apply_tf(counts[keep], self.tf_fun)
         return d_u[keep], self.columns[pos[keep]], values
 
-    def _vectorize(self, docs) -> SparseRows:
-        d, c, v = self._match(docs)
+    def _vectorize(self, docs, precomputed=None) -> SparseRows:
+        d, c, v = self._match(docs, precomputed=precomputed)
         return _to_sparse_rows(d, c, v, len(docs), self.num_features)
 
     def apply(self, tokens):
@@ -238,8 +296,25 @@ class PackedTextVectorizer(Transformer):
         ]
 
     def apply_batch(self, data) -> Dataset:
-        docs = [list(doc) for doc in Dataset.of(data)]
+        data = Dataset.of(data)
+        if self._train_cache is not None:
+            payload, (d_u, g_u, counts, n_docs) = self._train_cache
+            if payload is data.payload:
+                # one intended hit (fit → apply on the train set): release
+                # the pinned corpus/grams afterwards
+                self._train_cache = None
+                rows = self._vectorize(
+                    [None] * n_docs, precomputed=(d_u, g_u, counts)
+                )
+                return Dataset(rows, batched=True)
+        docs = [list(doc) for doc in data]
         return Dataset(self._vectorize(docs), batched=True)
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_train_cache"] = None   # process-local identity cache
+        state["_sorted_vocab"] = None  # rebuilt lazily after load
+        return state
 
 
 class PackedTextFeatures(Estimator):
@@ -262,10 +337,11 @@ class PackedTextFeatures(Estimator):
         self.tf_fun = tf_fun
 
     def fit(self, data: Dataset) -> PackedTextVectorizer:
-        docs = [list(doc) for doc in Dataset.of(data)]
+        data = Dataset.of(data)
+        docs = [list(doc) for doc in data]
         vocab: Dict[str, int] = {}
         ids = _token_ids(docs, vocab, grow=True)
-        _, g_u, _counts = _per_doc_unique(
+        d_u, g_u, counts = _per_doc_unique(
             *_corpus_grams(ids, self.orders)
         )
         # document frequency + first-seen uid over the uid-ordered stream
@@ -275,10 +351,16 @@ class PackedTextFeatures(Estimator):
         rank = np.lexsort((first_seen, -df))[: self.num_features]
         chosen = sel[rank]
         sort_order = np.argsort(chosen)
-        return PackedTextVectorizer(
+        v = PackedTextVectorizer(
             vocab,
             chosen[sort_order],
             np.arange(len(chosen), dtype=np.int64)[sort_order],
             self.orders,
             self.tf_fun,
         )
+        # The standard pipeline flow applies the fitted vectorizer to the
+        # SAME training dataset next; the per-doc gram stream was just
+        # computed, so hand it over keyed by payload identity (the Spark
+        # analogue: the training featurization RDD stays cached).
+        v._train_cache = (data.payload, (d_u, g_u, counts, len(docs)))
+        return v

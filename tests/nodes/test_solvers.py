@@ -31,6 +31,7 @@ from keystone_tpu.nodes.learning.linear import (
 from keystone_tpu.nodes.learning.weighted import (
     BlockWeightedLeastSquaresEstimator,
     PerClassWeightedLeastSquaresEstimator,
+    ReWeightedLeastSquaresEstimator,
 )
 
 
@@ -56,6 +57,65 @@ def test_block_weighted_agrees_with_per_class():
     pb = np.asarray(block.apply_batch(Dataset.of(X)).to_array())
     pc = np.asarray(per_class.apply_batch(Dataset.of(X)).to_array())
     np.testing.assert_allclose(pb, pc, rtol=5e-2, atol=5e-2)
+
+
+def test_weighted_family_three_way_agreement_mixed_balance():
+    """block ≈ exact per-class ≈ iterative reweighted BCD at heavily mixed
+    class balance (VERDICT r3 #8; parity: the reference validates its block
+    solver against the per-class path, whose inner solver is
+    internal/ReWeightedLeastSquares.scala:18 — here all three are compared
+    pairwise on one problem)."""
+    rng = np.random.default_rng(7)
+    n, d, k = 160, 12, 4
+    # mixed balance: class sizes roughly 8 / 24 / 48 / 80
+    y = np.repeat(np.arange(k), [8, 24, 48, 80])
+    rng.shuffle(y)
+    W = rng.standard_normal((d, k))
+    X = (rng.standard_normal((n, d)) + 0.5 * W.T[y]).astype(np.float32)
+    Y = -np.ones((n, k), dtype=np.float32)
+    Y[np.arange(n), y] = 1.0
+
+    args = dict(lam=0.5, mixture_weight=0.3)
+    block = BlockWeightedLeastSquaresEstimator(4, 25, **args).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    exact = PerClassWeightedLeastSquaresEstimator(4, 1, **args).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    reweighted = ReWeightedLeastSquaresEstimator(4, 25, **args).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    pb = np.asarray(block.apply_batch(Dataset.of(X)).to_array())
+    pe = np.asarray(exact.apply_batch(Dataset.of(X)).to_array())
+    pr = np.asarray(reweighted.apply_batch(Dataset.of(X)).to_array())
+    # the iterative BCD converges to the exact per-class solution
+    np.testing.assert_allclose(pr, pe, rtol=2e-2, atol=2e-2)
+    # and the block solver agrees with both (its iteration path differs)
+    np.testing.assert_allclose(pb, pe, rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(pb, pr, rtol=5e-2, atol=5e-2)
+
+
+def test_reweighted_solver_single_block_is_exact():
+    """With one block and one iteration the reweighted update IS the closed
+    form (Gram cache + rhs reduce to the normal equations), pinning the
+    weighted algebra itself."""
+    from keystone_tpu.nodes.learning.weighted import solve_reweighted_l2
+
+    rng = np.random.default_rng(3)
+    n, d, k = 64, 6, 2
+    A = rng.standard_normal((n, d)).astype(np.float32)
+    y = rng.standard_normal((n, k)).astype(np.float32)
+    b = rng.random(n).astype(np.float32) + 0.1
+    reg = 0.3
+    Ws = solve_reweighted_l2([A], y, b, reg=reg, num_iter=1)
+    A64, y64, b64 = (
+        A.astype(np.float64), y.astype(np.float64), b.astype(np.float64)
+    )
+    want = np.linalg.solve(
+        A64.T @ (A64 * b64[:, None]) + reg * np.eye(d),
+        A64.T @ (y64 * b64[:, None]),
+    )
+    np.testing.assert_allclose(np.asarray(Ws[0]), want, rtol=1e-3, atol=1e-3)
 
 
 def test_block_weighted_learns_class_structure():
