@@ -741,6 +741,12 @@ def bench_imagenet_fv() -> dict:
         te_i, te_l = synthetic_imagenet(
             n_test, num_classes, size=image_size, seed=9
         )
+        # train batch resident in HBM before the fit timer (the reference's
+        # analogue: data cached in RDDs before its timer); upload recorded
+        t0 = time.perf_counter()
+        tr_i = jax.device_put(tr_i)
+        _fetch_scalar(tr_i)
+        t_train_h2d = time.perf_counter() - t0
 
         timing.enable()  # own scope (no dependence on bench order)
         timing.reset()
@@ -814,6 +820,7 @@ def bench_imagenet_fv() -> dict:
                 t_eager - t_fused, 3
             ),
             "phases": {
+                f"train_h2d_{n_train}imgs": round(t_train_h2d, 3),
                 f"fit_{n_train}imgs": round(t_fit, 3),
                 f"first_apply_{n_test}imgs": round(t_first_apply, 3),
                 f"h2d_{batch_n}img_batch": round(t_h2d, 3),

@@ -47,9 +47,51 @@ _WORD_BITS = 20
 _MAX_VOCAB = 1 << _WORD_BITS
 
 
+#: beyond this token width the fixed-width-unicode fast path costs more
+#: memory than it saves (see _token_ids); the dict loop takes over
+_MAX_VECTORIZED_TOKEN_LEN = 256
+
+
+def _token_ids_dict(
+    docs: Sequence[Sequence[str]],
+    vocab: Dict[str, int],
+    grow: bool,
+) -> List[np.ndarray]:
+    """Per-token dict loop — the fallback for pathologically wide tokens."""
+    out = []
+    get = vocab.get
+    if grow:
+        for doc in docs:
+            arr = np.empty(len(doc), dtype=np.int64)
+            for i, t in enumerate(doc):
+                j = get(t)
+                if j is None:
+                    j = len(vocab)
+                    vocab[t] = j
+                arr[i] = j
+            out.append(arr)
+    else:
+        for doc in docs:
+            out.append(
+                np.fromiter(
+                    (get(t, -1) for t in doc), dtype=np.int64, count=len(doc)
+                )
+            )
+    if len(vocab) > _MAX_VOCAB:
+        raise ValueError(
+            f"vocabulary {len(vocab)} exceeds the 2^{_WORD_BITS} packed-id "
+            "limit; use the composed NGramsFeaturizer chain"
+        )
+    return out
+
+
 def _sorted_vocab(vocab: Dict[str, int]):
     """(sorted keys array, aligned ids) for the vectorized lookup; built
-    once per fitted vectorizer (the vocab is immutable after fit)."""
+    once per fitted vectorizer (the vocab is immutable after fit). Returns
+    None when any key exceeds the fixed-width limit (the lookup would
+    allocate V×max_len×4 bytes) — callers fall back to the dict loop."""
+    if any(len(k) > _MAX_VECTORIZED_TOKEN_LEN for k in vocab):
+        return None
     keys = np.asarray(list(vocab.keys()), dtype=str)
     vals = np.asarray(list(vocab.values()), dtype=np.int64)
     sort = np.argsort(keys)
@@ -75,6 +117,15 @@ def _token_ids(
     total = sum(lengths)
     if total == 0:
         return [np.empty(0, dtype=np.int64) for _ in docs]
+    # fixed-width '<U' arrays give C-speed unique/searchsorted, but their
+    # width is the LONGEST token — one 10k-char base64 blob in a 5M-token
+    # corpus would inflate the allocation to corpus×max_len×4 bytes. Fall
+    # back to the dict loop beyond a sane token width.
+    max_len = max(
+        (len(t) for doc in docs for t in doc), default=0
+    )
+    if max_len > _MAX_VECTORIZED_TOKEN_LEN:
+        return _token_ids_dict(docs, vocab, grow)
     flat = np.concatenate([np.asarray(doc, dtype=object) for doc in docs])
     flat = flat.astype(str)
     if grow:
@@ -106,11 +157,10 @@ def _token_ids(
         if not vocab:
             ids_flat = np.full(total, -1, dtype=np.int64)
         else:
-            keys, vals = (
-                sorted_vocab
-                if sorted_vocab is not None
-                else _sorted_vocab(vocab)
-            )
+            sv = sorted_vocab if sorted_vocab is not None else _sorted_vocab(vocab)
+            if sv is None:  # wide vocab keys: fixed-width lookup unsafe
+                return _token_ids_dict(docs, vocab, grow)
+            keys, vals = sv
             pos = np.searchsorted(keys, flat)
             pos = np.clip(pos, 0, len(keys) - 1)
             hit = keys[pos] == flat
@@ -263,6 +313,8 @@ class PackedTextVectorizer(Transformer):
             d_u, g_u, counts = precomputed
         else:
             if self._sorted_vocab is None and self.vocab:
+                # may stay None (wide vocab keys) — _token_ids then takes
+                # the dict path; rebuilding the None is a cheap key scan
                 self._sorted_vocab = _sorted_vocab(self.vocab)
             ids = _token_ids(
                 docs, self.vocab, grow=False,
@@ -298,15 +350,23 @@ class PackedTextVectorizer(Transformer):
     def apply_batch(self, data) -> Dataset:
         data = Dataset.of(data)
         if self._train_cache is not None:
-            payload, (d_u, g_u, counts, n_docs) = self._train_cache
+            payload, fingerprint, (d_u, g_u, counts, n_docs) = self._train_cache
             if payload is data.payload:
                 # one intended hit (fit → apply on the train set): release
-                # the pinned corpus/grams afterwards
+                # the pinned corpus/grams afterwards. The fingerprint
+                # (doc count + total tokens) catches in-place mutation of
+                # the payload between fit and apply — fall through to a
+                # fresh featurization rather than serve stale grams.
                 self._train_cache = None
-                rows = self._vectorize(
-                    [None] * n_docs, precomputed=(d_u, g_u, counts)
-                )
-                return Dataset(rows, batched=True)
+                n_now, tok_now = 0, 0
+                for doc in data:
+                    n_now += 1
+                    tok_now += len(doc)
+                if (n_now, tok_now) == fingerprint:
+                    rows = self._vectorize(
+                        [None] * n_docs, precomputed=(d_u, g_u, counts)
+                    )
+                    return Dataset(rows, batched=True)
         docs = [list(doc) for doc in data]
         return Dataset(self._vectorize(docs), batched=True)
 
@@ -362,5 +422,8 @@ class PackedTextFeatures(Estimator):
         # SAME training dataset next; the per-doc gram stream was just
         # computed, so hand it over keyed by payload identity (the Spark
         # analogue: the training featurization RDD stays cached).
-        v._train_cache = (data.payload, (d_u, g_u, counts, len(docs)))
+        fingerprint = (len(docs), sum(len(doc) for doc in docs))
+        v._train_cache = (
+            data.payload, fingerprint, (d_u, g_u, counts, len(docs))
+        )
         return v
