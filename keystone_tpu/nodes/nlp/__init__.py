@@ -18,6 +18,7 @@ from .ngrams import (
     WordFrequencyTransformer,
 )
 from .stupid_backoff import (
+    PackedStupidBackoffModel,
     StupidBackoffEstimator,
     StupidBackoffModel,
     score_stupid_backoff,
@@ -39,6 +40,7 @@ __all__ = [
     "PackedTextVectorizer",
     "WordFrequencyEncoder",
     "WordFrequencyTransformer",
+    "PackedStupidBackoffModel",
     "StupidBackoffEstimator",
     "StupidBackoffModel",
     "score_stupid_backoff",

@@ -32,8 +32,10 @@ class NaiveBitPackIndexer:
     @staticmethod
     def pack(ngram: Sequence[int]) -> int:
         for w in ngram:
-            if w >= (1 << _WORD_BITS):
-                raise ValueError(f"word id {w} >= 2^20")
+            if w >= (1 << _WORD_BITS) or w < 0:
+                # negative ids (e.g. the -1 OOV sentinel) would sign-extend
+                # into the control bits and corrupt the packed order
+                raise ValueError(f"word id {w} outside [0, 2^20)")
         n = len(ngram)
         if n == 1:
             return ngram[0] << 40
@@ -84,7 +86,15 @@ class NaiveBitPackIndexer:
 
     @staticmethod
     def pack_batch(words: np.ndarray, order: int) -> np.ndarray:
-        """(n, order) int word-id matrix → (n,) packed int64 array."""
+        """(n, order) int word-id matrix → (n,) packed int64 array.
+
+        Unlike scalar :meth:`pack`, ids are NOT range-checked: the
+        packed-features apply path deliberately streams the -1 OOV
+        sentinel through — any gram containing -1 sign-extends negative,
+        and legitimate packs are non-negative, so OOV grams can never
+        collide with a real key (they just miss every lookup). Callers
+        doing table *construction* (not lookup) must validate ids
+        themselves, as PackedStupidBackoffModel.from_model does."""
         words = np.asarray(words, dtype=np.int64)
         if order == 1:
             return words[:, 0] << 40
@@ -113,6 +123,40 @@ class NaiveBitPackIndexer:
             axis=1,
         )
         return words, orders
+
+    @staticmethod
+    def order_batch(packed: np.ndarray) -> np.ndarray:
+        """(n,) packed → (n,) orders (control bits + 1)."""
+        return ((np.asarray(packed, dtype=np.int64) >> 60) & 0xF) + 1
+
+    @staticmethod
+    def farthest_word_batch(packed: np.ndarray) -> np.ndarray:
+        """(n,) packed → (n,) word id at position 0."""
+        return (np.asarray(packed, dtype=np.int64) >> 40) & _WORD_MASK
+
+    @staticmethod
+    def remove_current_word_batch(
+        q: np.ndarray, orders: np.ndarray
+    ) -> np.ndarray:
+        """Vectorized :meth:`remove_current_word` (orders 2 and 3 only —
+        other entries produce unspecified values; callers mask them)."""
+        lo40 = np.int64((1 << 40) - 1)
+        ctrl = np.int64(0xF) << np.int64(60)
+        bigram_to_uni = q & ~lo40 & ~ctrl
+        trigram_to_bi = (q & ~np.int64(_WORD_MASK) & ~ctrl) | (
+            np.int64(1) << np.int64(60)
+        )
+        return np.where(orders == 2, bigram_to_uni, trigram_to_bi)
+
+    @staticmethod
+    def remove_farthest_word_batch(
+        q: np.ndarray, orders: np.ndarray
+    ) -> np.ndarray:
+        """Vectorized :meth:`remove_farthest_word` (orders 2 and 3)."""
+        shifted = (q & np.int64((1 << 40) - 1)) << np.int64(20)
+        return np.where(
+            orders == 2, shifted, shifted | (np.int64(1) << np.int64(60))
+        )
 
 
 class NGramIndexerImpl:

@@ -157,7 +157,10 @@ def _token_ids(
         if not vocab:
             ids_flat = np.full(total, -1, dtype=np.int64)
         else:
-            sv = sorted_vocab if sorted_vocab is not None else _sorted_vocab(vocab)
+            # sorted_vocab: None = build here; False = caller already
+            # determined the fixed-width lookup is unsafe (wide keys)
+            sv = _sorted_vocab(vocab) if sorted_vocab is None \
+                else (sorted_vocab or None)
             if sv is None:  # wide vocab keys: fixed-width lookup unsafe
                 return _token_ids_dict(docs, vocab, grow)
             keys, vals = sv
@@ -313,9 +316,10 @@ class PackedTextVectorizer(Transformer):
             d_u, g_u, counts = precomputed
         else:
             if self._sorted_vocab is None and self.vocab:
-                # may stay None (wide vocab keys) — _token_ids then takes
-                # the dict path; rebuilding the None is a cheap key scan
-                self._sorted_vocab = _sorted_vocab(self.vocab)
+                # False = built-and-unsafe (wide vocab keys): _token_ids
+                # takes the dict path without re-scanning the vocab keys
+                # on every serve call
+                self._sorted_vocab = _sorted_vocab(self.vocab) or False
             ids = _token_ids(
                 docs, self.vocab, grow=False,
                 sorted_vocab=self._sorted_vocab,
@@ -354,15 +358,24 @@ class PackedTextVectorizer(Transformer):
             if payload is data.payload:
                 # one intended hit (fit → apply on the train set): release
                 # the pinned corpus/grams afterwards. The fingerprint
-                # (doc count + total tokens) catches in-place mutation of
-                # the payload between fit and apply — fall through to a
-                # fresh featurization rather than serve stale grams.
+                # (doc count + total tokens) catches SIZE-CHANGING in-place
+                # mutation of the payload between fit and apply — fall
+                # through to a fresh featurization rather than serve stale
+                # grams. Same-size element edits are not detected (full
+                # content hashing would cost what the cache saves); docs
+                # without __len__ (e.g. generators, already consumed by
+                # fit) skip the check — they cannot be re-featurized at
+                # all, so the cached grams are the only correct answer.
                 self._train_cache = None
                 n_now, tok_now = 0, 0
+                sized = True
                 for doc in data:
+                    if not hasattr(doc, "__len__"):
+                        sized = False
+                        break
                     n_now += 1
                     tok_now += len(doc)
-                if (n_now, tok_now) == fingerprint:
+                if not sized or (n_now, tok_now) == fingerprint:
                     rows = self._vectorize(
                         [None] * n_docs, precomputed=(d_u, g_u, counts)
                     )

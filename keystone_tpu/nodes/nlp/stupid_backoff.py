@@ -8,19 +8,34 @@ frequencies with a recursive α-discounted backoff:
     S(w_i)           = freq(w_i) / numTokens
 
 The reference keeps counts in an RDD partitioned by initial bigram
-(InitialBigramPartitioner) and scores per-partition; here the count table is
-one host dict (the single-process reduction of that shuffle) and
-``score_batch`` vectorizes scoring over an array of n-grams via the same
-recursion. The n-gram keys are tuples (NGramIndexerImpl packing).
+(InitialBigramPartitioner) and scores per-partition; here two forms exist:
+
+* the **dict form** (:class:`StupidBackoffModel`) — the single-process
+  reduction of that shuffle: one host dict keyed by tuples
+  (NGramIndexerImpl packing), scored per query in Python. Scale ceiling:
+  per-query Python recursion + per-key tuple hashing make it practical to
+  ~10^6 table entries / ~10^5 queries per call; beyond that use the
+  packed form.
+* the **packed array form** (:class:`PackedStupidBackoffModel`) — the
+  TPU-shaped layout (NaiveBitPackIndexer): every n-gram of order ≤ 3 is
+  one int64, the whole table is a pair of sorted flat arrays, and
+  scoring is a fixed number of vectorized backoff sweeps
+  (``searchsorted`` per level, numpy masks for hit/miss). Bounded by
+  host RAM (~10^8-10^9 entries) with O(log n) per query per level; the
+  same flat-int64 layout is what a device port would shard (the table
+  rides HBM, queries gather) — kept on host here because the tables are
+  corpus-sized, not model-sized.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Sequence, Tuple
 
+import numpy as np
+
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer
-from .indexers import NGramIndexerImpl
+from .indexers import NaiveBitPackIndexer, NGramIndexerImpl
 
 
 def score_stupid_backoff(
@@ -119,4 +134,174 @@ class StupidBackoffEstimator(Estimator):
             scores[ngram] = s
         return StupidBackoffModel(
             scores, ngram_counts, self.unigram_counts, num_tokens, self.alpha
+        )
+
+
+class PackedStupidBackoffModel(Transformer):
+    """Stupid Backoff over NaiveBitPackIndexer-packed int64 arrays.
+
+    Same recursion as :func:`score_stupid_backoff` (parity:
+    StupidBackoff.scala:63-95), executed as at most ``max_order`` masked
+    vectorized sweeps over the whole query batch: each sweep settles
+    unigram queries (freq/numTokens), settles hits (freq/contextFreq via
+    one context ``searchsorted``), and backs off the rest (strip the
+    farthest word, multiply α in). Agreement with the dict path is exact
+    (same operation order per query) — asserted in
+    tests/nodes/test_nlp.py.
+    """
+
+    def __init__(self, keys: np.ndarray, counts: np.ndarray,
+                 uni_ids: np.ndarray, uni_counts: np.ndarray,
+                 num_tokens: int, alpha: float = 0.4):
+        order = np.argsort(keys, kind="stable")
+        self.keys = np.asarray(keys, dtype=np.int64)[order]
+        self.counts = np.asarray(counts, dtype=np.int64)[order]
+        order = np.argsort(uni_ids, kind="stable")
+        self.uni_ids = np.asarray(uni_ids, dtype=np.int64)[order]
+        self.uni_counts = np.asarray(uni_counts, dtype=np.int64)[order]
+        self.num_tokens = int(num_tokens)
+        self.alpha = float(alpha)
+
+    @classmethod
+    def from_model(cls, model: StupidBackoffModel) -> "PackedStupidBackoffModel":
+        """Build the packed tables from a fitted dict-form model. Orders
+        above 3 don't fit the 64-bit packing — the dict form remains the
+        only representation there (stated ceiling, module docstring)."""
+        if any(len(g) > 3 for g in model.ngram_counts):
+            raise ValueError(
+                "packed form covers orders <= 3 (NaiveBitPackIndexer); "
+                "use the dict-form StupidBackoffModel for higher orders"
+            )
+        for g in model.ngram_counts:
+            if not all(isinstance(w, (int, np.integer)) for w in g):
+                raise ValueError(
+                    f"packed form needs integer word ids in [0, 2^20) "
+                    f"(got {g!r}); encode words first, e.g. via "
+                    f"WordFrequencyEncoder"
+                )
+            break  # one key suffices for the type check — homogeneous
+        # Negative ids (e.g. WordFrequencyEncoder's -1 OOV sentinel) would
+        # sign-extend into the control bits and corrupt the packed order —
+        # reject them here; pack() rejects ids >= 2^20.
+        if any(w < 0 for g in model.ngram_counts for w in g):
+            raise ValueError(
+                "packed form needs non-negative word ids; filter or remap "
+                "the -1 unknown-token sentinel before packing"
+            )
+        items = list(model.ngram_counts.items())
+        if items:
+            keys = np.fromiter(
+                (NaiveBitPackIndexer.pack(g) for g, _ in items),
+                dtype=np.int64, count=len(items),
+            )
+            counts = np.fromiter(
+                (c for _, c in items), dtype=np.int64, count=len(items)
+            )
+        else:  # pragma: no cover - empty corpus
+            keys = counts = np.zeros(0, dtype=np.int64)
+        uni = list(model.unigram_counts.items())
+        uni_ids = np.asarray([w for w, _ in uni], dtype=np.int64)
+        uni_counts = np.asarray([c for _, c in uni], dtype=np.int64)
+        return cls(keys, counts, uni_ids, uni_counts, model.num_tokens,
+                   model.alpha)
+
+    @staticmethod
+    def _sorted_probe(keys: np.ndarray, vals: np.ndarray,
+                      q: np.ndarray) -> np.ndarray:
+        """count-or-0 lookup of q in the sorted (keys, vals) table."""
+        if not len(keys):
+            return np.zeros(q.shape, dtype=np.int64)
+        pos = np.searchsorted(keys, q)
+        pos = np.minimum(pos, len(keys) - 1)
+        return np.where(keys[pos] == q, vals[pos], 0)
+
+    def _lookup_table(self, q: np.ndarray) -> np.ndarray:
+        return self._sorted_probe(self.keys, self.counts, q)
+
+    def _lookup_uni(self, word_ids: np.ndarray) -> np.ndarray:
+        return self._sorted_probe(self.uni_ids, self.uni_counts, word_ids)
+
+    def _freq_initial(self, q: np.ndarray, orders: np.ndarray) -> np.ndarray:
+        """freq for the ORIGINAL query: the n-gram table first, with the
+        dict path's unigram fallback for order-1 queries that miss
+        (score_stupid_backoff's pre-loop lookup)."""
+        freq = self._lookup_table(q)
+        uni = orders == 1
+        if uni.any():
+            fallback = self._lookup_uni(NaiveBitPackIndexer.farthest_word_batch(q))
+            freq = np.where(uni & (freq == 0), fallback, freq)
+        return freq
+
+    def _freq_backoff(self, q: np.ndarray, orders: np.ndarray) -> np.ndarray:
+        """freq after a backoff step: order-1 results read ONLY the
+        unigram table (the dict path's in-loop lookup never consults the
+        n-gram table for backed-off unigrams)."""
+        uni = orders == 1
+        freq = self._lookup_table(q)
+        if uni.any():
+            freq = np.where(
+                uni, self._lookup_uni(NaiveBitPackIndexer.farthest_word_batch(q)), freq
+            )
+        return freq
+
+    def score_packed(self, q: np.ndarray) -> np.ndarray:
+        q = np.asarray(q, dtype=np.int64).copy()
+        n = len(q)
+        accum = np.ones(n, dtype=np.float64)
+        score = np.zeros(n, dtype=np.float64)
+        done = np.zeros(n, dtype=bool)
+        orders = NaiveBitPackIndexer.order_batch(q)
+        freq = self._freq_initial(q, orders)
+        for _ in range(NaiveBitPackIndexer.max_ngram_order):
+            # unigrams: S(w) = freq(w)/numTokens
+            m = (orders == 1) & ~done
+            score[m] = accum[m] * freq[m] / self.num_tokens
+            done |= m
+            # hits: S = freq(ngram)/freq(context)
+            hit = ~done & (freq != 0)
+            if hit.any():
+                ctx = NaiveBitPackIndexer.remove_current_word_batch(
+                    q[hit], orders[hit]
+                )
+                cfreq = np.where(
+                    orders[hit] == 2,
+                    self._lookup_uni(NaiveBitPackIndexer.farthest_word_batch(ctx)),
+                    self._lookup_table(ctx),
+                )
+                if np.any(cfreq == 0):  # fail fast like the dict path
+                    raise ZeroDivisionError(
+                        "context frequency 0 for a fitted n-gram — the "
+                        "count table is inconsistent (missing context)"
+                    )
+                score[hit] = accum[hit] * freq[hit] / cfreq
+                done |= hit
+            if done.all():
+                break
+            # misses: back off to the shorter context, α-discounted
+            rest = ~done
+            q[rest] = NaiveBitPackIndexer.remove_farthest_word_batch(
+                q[rest], orders[rest]
+            )
+            orders[rest] -= 1
+            freq[rest] = self._freq_backoff(q[rest], orders[rest])
+            accum[rest] *= self.alpha
+        return score
+
+    def score(self, ngram: Sequence) -> float:
+        return float(
+            self.score_packed(
+                np.asarray([NaiveBitPackIndexer.pack(tuple(ngram))])
+            )[0]
+        )
+
+    def score_batch(self, ngrams: Sequence[Sequence]) -> np.ndarray:
+        packed = np.fromiter(
+            (NaiveBitPackIndexer.pack(tuple(g)) for g in ngrams),
+            dtype=np.int64, count=len(ngrams),
+        )
+        return self.score_packed(packed)
+
+    def apply(self, x):
+        raise TypeError(
+            "Doesn't make sense to chain this node; use score(ngram)."
         )

@@ -37,8 +37,14 @@ from .rules import Annotations, Rule
 #: process-global jitted-callable cache keyed by the fused chain's
 #: structural content (see FusedTransformerOperator._jitted). Holds the
 #: first instance's ops (and their params) alive — the price of executable
-#: reuse, same order of memory as the fitted pipelines themselves.
-_FUSED_JIT_CACHE: dict = {}
+#: reuse, same order of memory as the fitted pipelines themselves. LRU:
+#: a long-lived sweep process re-fitting many distinct pipelines gets a
+#: fresh key per fit (param digests differ), so without a bound every
+#: discarded pipeline's weights would stay pinned for the process life.
+from collections import OrderedDict
+
+_FUSED_JIT_CACHE: "OrderedDict" = OrderedDict()
+_FUSED_JIT_CACHE_MAX = 64
 
 
 class FusedTransformerOperator(TransformerOperator):
@@ -111,6 +117,10 @@ class FusedTransformerOperator(TransformerOperator):
                 cached = _FUSED_JIT_CACHE.get(key)
                 if cached is None:
                     cached = _FUSED_JIT_CACHE[key] = jax.jit(self.trace_batch)
+                    while len(_FUSED_JIT_CACHE) > _FUSED_JIT_CACHE_MAX:
+                        _FUSED_JIT_CACHE.popitem(last=False)
+                else:
+                    _FUSED_JIT_CACHE.move_to_end(key)
                 self._jit = cached
         return self._jit
 

@@ -76,6 +76,13 @@ class RuleExecutor:
                     graph, ann = rule.apply(graph, ann)
                 if batch.strategy == Strategy.ONCE:
                     break
+                # Cost note: every rule returns its input graph object
+                # unchanged on a no-op pass, and tuple/dict equality
+                # short-circuits on identity (PyObject_RichCompareBool), so
+                # the converged iteration costs O(len(ann)) identity checks,
+                # not a whole-graph structural compare; the deep compare
+                # only runs when a rule rebuilt the graph, where it fails
+                # fast on the first differing field.
                 if (graph, ann) == before:
                     break
                 if iteration >= batch.max_iterations:

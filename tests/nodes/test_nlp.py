@@ -224,6 +224,97 @@ def test_stupid_backoff_fitted_scores_in_unit_interval():
     assert all(0.0 <= s <= 1.0 for s in lm.scores.values())
 
 
+def test_packed_stupid_backoff_agrees_with_dict_path():
+    """The packed-int64 array form scores bit-identically to the dict
+    recursion on every fitted n-gram AND on out-of-corpus queries that
+    exercise each backoff depth (unseen trigram → bigram → unigram →
+    zero)."""
+    import numpy as np
+
+    from keystone_tpu.nodes.nlp.stupid_backoff import (
+        PackedStupidBackoffModel,
+    )
+    from keystone_tpu.pipelines.stupid_backoff_pipeline import (
+        synthetic_corpus,
+        train_language_model,
+    )
+
+    lm = train_language_model(synthetic_corpus(80, seed=3), n=3)
+    packed = PackedStupidBackoffModel.from_model(lm)
+
+    queries = list(lm.ngram_counts)  # every fitted 2-/3-gram
+    vocab = sorted(lm.unigram_counts)
+    hi = max(vocab) + 7  # ids never seen in the corpus
+    queries += [
+        (v,) for v in vocab[:5]
+    ] + [
+        (hi,),                        # OOV unigram → score 0
+        (hi, vocab[0]),               # backoff to seen unigram
+        (vocab[0], hi),               # unseen current word
+        (hi, hi + 1, hi + 2),         # fully OOV trigram (depth 2)
+        (hi, vocab[0], vocab[1]) if len(vocab) > 1 else (hi, vocab[0]),
+    ]
+    want = np.asarray([lm.score(q) for q in queries])
+    got = packed.score_batch(queries)
+    assert np.allclose(got, want, rtol=1e-12, atol=0.0)
+    assert packed.score(queries[0]) == want[0]
+
+
+def test_packed_stupid_backoff_backoff_reads_unigram_table_only():
+    """Dict-path parity in the corner the recursion makes subtle: a
+    backed-off unigram reads ONLY the unigram table, even when the n-gram
+    table also holds an order-1 entry for the same word (the pre-loop
+    lookup consults the table; the in-loop one does not)."""
+    import numpy as np
+
+    from keystone_tpu.nodes.nlp.stupid_backoff import (
+        PackedStupidBackoffModel,
+        StupidBackoffModel,
+    )
+
+    ngram_counts = {(1, 2): 4, (2,): 7}
+    unigram_counts = {1: 10, 2: 3}
+    lm = StupidBackoffModel({}, ngram_counts, unigram_counts, 13)
+    packed = PackedStupidBackoffModel.from_model(lm)
+    for q in [(99, 2), (2,), (1, 2), (99,)]:
+        assert packed.score(q) == lm.score(q), q
+
+
+def test_packed_stupid_backoff_empty_and_zero_context():
+    import numpy as np
+    import pytest as _pytest
+
+    from keystone_tpu.nodes.nlp.stupid_backoff import (
+        PackedStupidBackoffModel,
+        StupidBackoffModel,
+    )
+
+    # empty tables score 0 everywhere instead of crashing
+    empty = PackedStupidBackoffModel(
+        np.zeros(0, np.int64), np.zeros(0, np.int64),
+        np.zeros(0, np.int64), np.zeros(0, np.int64), num_tokens=1,
+    )
+    assert empty.score((3, 4)) == 0.0
+    # a fitted n-gram whose context is missing fails fast (dict-path
+    # parity: ZeroDivisionError), not inf
+    lm = StupidBackoffModel({}, {(1, 2): 4}, {2: 3}, 7)
+    packed = PackedStupidBackoffModel.from_model(lm)
+    with _pytest.raises(ZeroDivisionError):
+        packed.score((1, 2))
+
+
+def test_packed_stupid_backoff_rejects_high_orders():
+    import pytest as _pytest
+
+    from keystone_tpu.nodes.nlp.stupid_backoff import (
+        PackedStupidBackoffModel,
+    )
+
+    lm = _stupid_backoff_lm()  # fits orders 2..5 over string tokens
+    with _pytest.raises(ValueError):
+        PackedStupidBackoffModel.from_model(lm)
+
+
 # ---- sparse features -----------------------------------------------------
 
 def test_term_frequency():

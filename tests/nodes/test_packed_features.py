@@ -125,3 +125,32 @@ def test_packed_empty_docs_and_short_docs():
     dense = _dense(sr)
     assert dense.shape[0] == 3
     assert dense[1].sum() == 0  # empty doc -> empty row
+
+
+def test_fit_then_apply_on_generator_payload_serves_cache():
+    """Docs without __len__ (generators, consumed by fit) can't be
+    re-featurized — the fit→apply identity hit must serve the cached
+    grams instead of crashing on len() or re-iterating exhausted
+    iterators."""
+    docs = _random_docs(20, 12, seed=7)
+    baseline_vec = PackedTextFeatures([1, 2], 50, lambda x: 1)
+    bv = baseline_vec.fit(Dataset.from_items(docs))
+    want = _dense(bv.apply_batch(Dataset.from_items(docs)).payload)
+
+    gen_ds = Dataset.from_items([iter(d) for d in docs])
+    est = PackedTextFeatures([1, 2], 50, lambda x: 1)
+    vec = est.fit(gen_ds)
+    got = _dense(vec.apply_batch(gen_ds).payload)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_size_changing_mutation_refeaturizes():
+    docs = [list(d) for d in _random_docs(10, 8, seed=9)]
+    est = PackedTextFeatures([1], 30, lambda x: 1)
+    vec = est.fit(Dataset.from_items(docs))
+    ds = Dataset.from_items(docs)
+    vec2 = PackedTextFeatures([1], 30, lambda x: 1).fit(ds)
+    ds.payload[0].append(ds.payload[1][0])  # size-changing mutation
+    got = _dense(vec2.apply_batch(ds).payload)
+    fresh = _dense(vec2.apply_batch(Dataset.from_items(ds.payload)).payload)
+    np.testing.assert_allclose(got, fresh, rtol=1e-6)
