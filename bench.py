@@ -630,20 +630,38 @@ def bench_mnist() -> dict:
             # via the pred chain, so fetching it forces the whole solve
             return solve_blockwise_l2(F_blocks, y, reg=reg)[-1]
 
-    CHAIN = 3
-    solve_times = []
-    for trial in range(3):
-        t0 = time.perf_counter()
-        last = None
-        for i in range(CHAIN):
-            last = run_solve(
-                conf.lam * (1.0 + (trial * CHAIN + i + 1) * 1e-7)
-            )
-        _fetch_scalar(last)
-        solve_times.append(
-            (time.perf_counter() - t0 - fetch_latency) / CHAIN
-        )
-    t_solve_steady = max(min(solve_times), 1e-9)
+    # Differential chain timing: the ~13 ms solve is far below the ~100 ms
+    # tunneled-fetch latency, so "chain minus a separately-measured fetch
+    # constant" is noise-dominated (round 3's first cut produced a
+    # physically impossible MFU > 1 that way). Timing a SHORT and a LONG
+    # chain and taking (t_long - t_short)/(n_long - n_short) cancels every
+    # per-chain constant (dispatch, fetch, sync) without assuming its
+    # value; reg is eps-varied per call so a memoizing transport can't
+    # replay.
+    # Per-trial differencing is still stall-sensitive (one stalled short
+    # chain makes the diff negative), so take the MIN time per chain
+    # length across trials first — min filters the intermittent transport
+    # stalls — and difference those.
+    N_SHORT, N_LONG = 4, 32
+    chain_raw = {}
+    eps_seq = 0  # globally unique multiplier per solve call: a memoizing
+    # transport can never replay any chained solve of any trial
+    for n_chain in (N_SHORT, N_LONG):
+        times = []
+        for trial in range(3):
+            t0 = time.perf_counter()
+            last = None
+            for i in range(n_chain):
+                eps_seq += 1
+                last = run_solve(conf.lam * (1.0 + eps_seq * 1e-7))
+            _fetch_scalar(last)
+            times.append(time.perf_counter() - t0)
+        chain_raw[str(n_chain)] = [round(t, 4) for t in times]
+    t_solve_steady = max(
+        (min(chain_raw[str(N_LONG)]) - min(chain_raw[str(N_SHORT)]))
+        / (N_LONG - N_SHORT),
+        1e-9,
+    )
     peak = _device_peak_flops()
     timing.enable(False)
     return {
@@ -675,11 +693,15 @@ def bench_mnist() -> dict:
         "solve_flops": solve_flops,
         "mfu_solve_e2e": round(solve_flops / t_fit / peak, 4),
         "mfu_solve_steady": round(solve_flops / t_solve_steady / peak, 4),
+        "solve_chain_raw_seconds": chain_raw,
         "mfu_floor_note": (
-            "solve_steady times CHAIN=3 chained one-dispatch scan programs "
-            "with one trailing fetch; its transport floor is "
-            "round_trip/CHAIN, subtracted-fetch residual error <= "
-            "marginal_dispatch per call"
+            f"solve_steady = (min t_chain{N_LONG} - min t_chain{N_SHORT})"
+            f" / {N_LONG - N_SHORT}: differential chain timing (min per "
+            "length over 3 trials, then the slope) cancels the per-chain "
+            "dispatch+fetch constant instead of subtracting a separately-"
+            "measured latency, which went noise-negative on a ~10 ms "
+            "solve under a ~100 ms tunneled fetch; min-first filters the "
+            "transport's intermittent stalls"
         ),
     }
 
@@ -748,12 +770,26 @@ def bench_imagenet_fv() -> dict:
         _fetch_scalar(tr_i)
         t_train_h2d = time.perf_counter() - t0
 
+        # Two fit attempts with FRESH estimator instances (the pipeline
+        # state table is keyed per instance, so the full featurize + EM +
+        # solve re-executes): attempt 1 carries every first-shape XLA
+        # compile (tens of seconds for the SIFT/LCS stacks), attempt 2 is
+        # the executable-warm cost — the honest steady fit time. Min
+        # reported as the headline, both attempts recorded.
         timing.enable()  # own scope (no dependence on bench order)
-        timing.reset()
-        t0 = time.perf_counter()
-        fitted = build_predictor(tr_i, tr_l, conf).fit()
-        t_fit = time.perf_counter() - t0
-        fit_phases = timing.snapshot()
+        fit_attempts = []
+        fit_phase_attempts = []
+        fitted = None
+        for _ in range(2):
+            timing.reset()
+            t0 = time.perf_counter()
+            fitted_i = build_predictor(tr_i, tr_l, conf).fit()
+            fit_attempts.append(time.perf_counter() - t0)
+            fit_phase_attempts.append(timing.snapshot())
+            if fitted is None:
+                fitted = fitted_i
+        t_fit = min(fit_attempts)
+        fit_phases = fit_phase_attempts[fit_attempts.index(t_fit)]
         timing.enable(False)
 
         # held-out top-5 error (the reference's quality metric, :139-141),
@@ -801,6 +837,19 @@ def bench_imagenet_fv() -> dict:
             eager_times.append(time.perf_counter() - t0)
         t_eager = min(eager_times)
 
+        # any-size serve through ONE executable (apply_chunked): the full
+        # test set, whose size is not a multiple of the chunk, rides the
+        # 64-row program — vs first_apply above, which recompiled the
+        # whole serve program at the test set's native shape
+        t0 = time.perf_counter()
+        o = fitted.apply_chunked(te_i, chunk_size=batch_n)
+        _fetch_scalar(o.to_array())
+        t_chunk_first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        o = fitted.apply_chunked(te_i, chunk_size=batch_n)
+        _fetch_scalar(o.to_array())
+        t_chunk_steady = time.perf_counter() - t0
+
         ips = batch_n / t_fused
         # featurize share of the fit: per-image apply flops × n_train is a
         # lower bound for the descriptor phases' device work (fit also
@@ -826,6 +875,10 @@ def bench_imagenet_fv() -> dict:
                 f"h2d_{batch_n}img_batch": round(t_h2d, 3),
                 f"steady_fused_apply_{batch_n}imgs": round(t_fused, 4),
                 f"steady_eager_apply_{batch_n}imgs": round(t_eager, 3),
+                f"chunked_apply_{n_test}imgs_first": round(t_chunk_first, 3),
+                f"chunked_apply_{n_test}imgs_steady": round(
+                    t_chunk_steady, 3
+                ),
             },
             "fit_phase_table": fit_phases,
             "fit_featurize_accounting": {
@@ -842,6 +895,7 @@ def bench_imagenet_fv() -> dict:
                 ),
             },
             "fused_apply_attempts": [round(t, 4) for t in fused_times],
+            "fit_attempts": [round(t, 3) for t in fit_attempts],
             "note": note,
             "config": (
                 f"descDim=64 vocabSize=16 (reference defaults); "

@@ -1,12 +1,20 @@
 """k-means++ (parity: nodes/learning/KMeansPlusPlus.scala:16,83).
 
 One round = the k-means++ initialization; more rounds = Lloyd's algorithm.
-Distance matrices, assignments and center updates are all batched matrix
-algebra on-device; the sequential k-means++ seeding loop stays host-side
-(it is inherently sequential and tiny: k draws).
+Everything — including the sequential D²-weighted seeding — runs as
+compiled device programs: the seeding is one ``lax.scan`` over k−1 steps
+with on-device categorical draws, and Lloyd's iterations are one
+``lax.while_loop`` with the reference's stop-on-non-improving-cost
+semantics. The first cut kept the seeding host-side ("inherently
+sequential and tiny: k draws") — but each draw fetched an n-element
+probability vector to the host, and through a tunneled transport those
+k−1 blocking fetches cost 10-18 s at n=200k; as one program the whole
+fit is a handful of dispatches.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +40,73 @@ def _one_hot_assign(X, means):
     d = _sq_dists(X, means)
     idx = jnp.argmin(d, axis=1)
     return jax.nn.one_hot(idx, means.shape[0], dtype=X.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _seed_plus_plus(X, key, k: int):
+    """k-means++ seeding as ONE program: scan over k−1 D²-weighted draws
+    (parity: the seeding loop of KMeansPlusPlusEstimator; the degenerate
+    all-points-covered case falls back to a uniform draw, as the host
+    version did)."""
+    n = X.shape[0]
+    xsq_half = 0.5 * jnp.sum(X * X, axis=1)
+    k0, key = jax.random.split(key)
+    c0 = X[jax.random.randint(k0, (), 0, n)]
+    if k == 1:
+        return c0[None]
+
+    def step(carry, _):
+        cur_sq, last_c, key = carry
+        sq_new = xsq_half - X @ last_c + 0.5 * jnp.dot(last_c, last_c)
+        cur_sq = jnp.minimum(cur_sq, sq_new)
+        probs = jnp.maximum(cur_sq, 0.0)
+        key, kw, ku = jax.random.split(key, 3)
+        # log(0) = −inf excludes already-covered points from the draw
+        idx_weighted = jax.random.categorical(kw, jnp.log(probs))
+        idx_uniform = jax.random.randint(ku, (), 0, n)
+        idx = jnp.where(jnp.sum(probs) > 0, idx_weighted, idx_uniform)
+        new_c = X[idx]
+        return (cur_sq, new_c, key), new_c
+
+    init = (jnp.full((n,), jnp.inf, X.dtype), c0, key)
+    _, rest = jax.lax.scan(step, init, None, length=k - 1)
+    return jnp.concatenate([c0[None], rest], axis=0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_iterations", "stop_tolerance")
+)
+def _lloyd_loop(X, means, *, max_iterations: int, stop_tolerance: float):
+    """Lloyd's iterations as ONE ``lax.while_loop`` program. Break
+    semantics match the host loop exactly: when the cost stops improving,
+    KEEP the current means (no final update); empty clusters stay where
+    they were."""
+    k = means.shape[0]
+
+    def cond(carry):
+        i, done, *_ = carry
+        return (i < max_iterations) & ~done
+
+    def body(carry):
+        i, done, prev_cost, has_prev, means = carry
+        dists = _sq_dists(X, means)
+        cost = jnp.mean(jnp.min(dists, axis=1))
+        stop = has_prev & ~(
+            prev_cost - cost >= stop_tolerance * jnp.abs(prev_cost)
+        )
+        assign = jax.nn.one_hot(jnp.argmin(dists, axis=1), k, dtype=X.dtype)
+        counts = assign.sum(axis=0)
+        new_means = (assign.T @ X) / jnp.maximum(counts, 1.0)[:, None]
+        new_means = jnp.where((counts > 0)[:, None], new_means, means)
+        m2 = jnp.where(stop, means, new_means)
+        return (i + 1, stop, cost, True, m2)
+
+    init = (
+        jnp.int32(0), jnp.bool_(False), jnp.float32(0.0), jnp.bool_(False),
+        means,
+    )
+    *_, means = jax.lax.while_loop(cond, body, init)
+    return means
 
 
 class KMeansModel(Transformer):
@@ -60,46 +135,12 @@ class KMeansPlusPlusEstimator(Estimator):
 
     def fit_matrix(self, X) -> KMeansModel:
         X = jnp.asarray(X, dtype=jnp.float32)
-        n, d = X.shape
-        k = self.num_means
-        rng = np.random.default_rng(self.seed)
-
-        # -- k-means++ seeding (sequential, host-driven) ---------------
-        centers = [int(rng.integers(0, n))]
-        xsq_half = 0.5 * jnp.sum(X * X, axis=1)
-        cur_sq = None
-        for i in range(k - 1):
-            c = X[centers[i]]
-            sq_new = xsq_half - X @ c + 0.5 * jnp.dot(c, c)
-            cur_sq = sq_new if cur_sq is None else jnp.minimum(cur_sq, sq_new)
-            probs = np.maximum(np.asarray(cur_sq), 0.0)
-            total = probs.sum()
-            if total <= 0:
-                centers.append(int(rng.integers(0, n)))
-            else:
-                centers.append(int(rng.choice(n, p=probs / total)))
-
-        means = X[jnp.asarray(centers)]
-
-        # -- Lloyd's iterations ---------------------------------------
-        prev_cost = None
-        for _ in range(self.max_iterations):
-            dists = _sq_dists(X, means)
-            cost = float(jnp.mean(jnp.min(dists, axis=1)))
-            if prev_cost is not None and not (
-                prev_cost - cost >= self.stop_tolerance * abs(prev_cost)
-            ):
-                break
-            prev_cost = cost
-            assign = jax.nn.one_hot(
-                jnp.argmin(dists, axis=1), k, dtype=X.dtype
-            )
-            counts = assign.sum(axis=0)
-            # keep empty clusters where they were (reference divides and gets
-            # NaN only for empty clusters, which don't occur with k-means++
-            # seeding on real data; guard anyway)
-            new_means = (assign.T @ X) / jnp.maximum(counts, 1.0)[:, None]
-            means = jnp.where(
-                (counts > 0)[:, None], new_means, means
-            )
+        means = _seed_plus_plus(
+            X, jax.random.PRNGKey(self.seed), self.num_means
+        )
+        means = _lloyd_loop(
+            X, means,
+            max_iterations=self.max_iterations,
+            stop_tolerance=self.stop_tolerance,
+        )
         return KMeansModel(means)

@@ -414,6 +414,54 @@ class FittedPipeline(Chainable):
         arr = Dataset.of(data).to_array() if not hasattr(data, "shape") else data
         return self._compiled(arr)
 
+    def apply_chunked(self, data: Any, chunk_size: int = 64) -> Dataset:
+        """Serve ANY batch size through one fixed-shape executable.
+
+        XLA specializes each program to its input shapes, so applying a
+        fitted pipeline to a new batch size recompiles the whole serve
+        program — tens of seconds for the image stacks, paid again for
+        every distinct size. Here the input is split into ``chunk_size``
+        row blocks (the tail padded by repeating its first row, sliced
+        off after), so every call after the first reuses one compiled
+        program regardless of input size.
+
+        Valid ONLY for row-wise chains — each output row a function of
+        its input row alone — which holds for every serve-path
+        transformer in this library's pipelines (fitted normalizers,
+        featurizers, linear models, classifiers). Batch-coupled nodes
+        must go through :meth:`apply`.
+        """
+        import numpy as np
+
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be positive")
+        if self._compiled is None:
+            self.compile()
+        arr = Dataset.of(data).to_array() if not hasattr(data, "shape") else data
+        n = int(arr.shape[0])
+        if n == 0:  # zero chunks would be produced; apply() handles empty
+            return self.apply(data)
+        outs = []
+        for i in range(0, n, chunk_size):
+            chunk = arr[i : i + chunk_size]
+            pad = chunk_size - int(chunk.shape[0])
+            if pad:
+                filler = np.repeat(np.asarray(chunk[:1]), pad, axis=0)
+                chunk = np.concatenate([np.asarray(chunk), filler], axis=0)
+            out = self._compiled(chunk)
+            if not hasattr(out, "shape"):
+                raise TypeError(
+                    "apply_chunked needs a single-array output; use apply() "
+                    "for gathered/tuple sinks"
+                )
+            outs.append(out[: chunk_size - pad] if pad else out)
+        import jax.numpy as jnp
+
+        return Dataset(
+            outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0),
+            batched=True,
+        )
+
     # -- persistence ----------------------------------------------------
 
     def save(self, path: str) -> None:

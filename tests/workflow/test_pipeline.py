@@ -196,3 +196,29 @@ def test_common_subexpression_merged():
     pipe = Pipeline.gather([shared.to_pipeline(), shared.to_pipeline()])
     pipe(jnp.ones((2, 2))).get()
     assert len(calls) == 1
+
+
+def test_apply_chunked_matches_apply_any_batch_size():
+    """apply_chunked pads the tail chunk and slices it off: results match
+    apply() exactly for sizes below, equal to, straddling, and far above
+    the chunk size — all through ONE compiled executable."""
+    est = CountingMeanCenter()
+    data = Dataset.from_array(jnp.asarray([[0.0, 0.0], [2.0, 2.0]]))
+    fitted = (Doubler() >> AddOne()).and_then(est, data).fit()
+    rng = np.random.default_rng(0)
+    for n in (1, 3, 4, 7, 13):
+        X = jnp.asarray(rng.standard_normal((n, 2)), dtype=jnp.float32)
+        want = np.asarray(fitted.apply(X).to_array())
+        got = np.asarray(fitted.apply_chunked(X, chunk_size=4).to_array())
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert got.shape[0] == n
+
+
+def test_apply_chunked_empty_input_matches_apply():
+    est = CountingMeanCenter()
+    data = Dataset.from_array(jnp.asarray([[0.0, 0.0], [2.0, 2.0]]))
+    fitted = Doubler().and_then(est, data).fit()
+    empty = jnp.zeros((0, 2), dtype=jnp.float32)
+    got = np.asarray(fitted.apply_chunked(empty, chunk_size=4).to_array())
+    want = np.asarray(fitted.apply(empty).to_array())
+    assert got.shape == want.shape == (0, 2)
