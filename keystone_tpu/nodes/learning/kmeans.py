@@ -18,7 +18,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ...data.dataset import Dataset
 from ...workflow.transformer import Estimator, Transformer

@@ -15,7 +15,7 @@ become a vmapped batched Cholesky. No resharding of the data ever happens.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import partial, wraps
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -28,6 +28,27 @@ from ...parallel.mesh import shard_classes
 from ...utils.jit import nestable_jit
 from ...workflow.transformer import LabelEstimator
 from .linear import BlockLinearMapper
+
+
+def _f32_true(fn):
+    """Run a weighted-family solve with f32-true matmuls.
+
+    The mixture normal matrices are regularized with λ as small as the
+    reference's ImageNet 6e-5 (ImageNetSiftLcsFV.scala:146) — BELOW the
+    noise floor of the TPU's default-bf16 matmul lowering (~1e-3·‖XᵀX‖).
+    At default precision the λ-decided near-null directions of jointXTX
+    come out noise-dominated and held-out predictions from BOTH the
+    dense and dual paths are near-random (measured: 9% argmax agreement
+    between two correct algorithms; 97% under f32-true). The reference
+    solves in f64 Breeze; f32-true is the TPU analogue, and these GEMMs
+    are a negligible share of pipeline compute."""
+
+    @wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+
+    return wrapped
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -58,6 +79,51 @@ def _batched_solve(jointXTX, rhs, lam):
     d = jointXTX.shape[-1]
     G = jointXTX + lam * jnp.eye(d, dtype=jointXTX.dtype)
     return jnp.linalg.solve(G, rhs[..., None])[..., 0]
+
+
+@nestable_jit
+def _dual_solve_chunk(Q, R, dvec, pm_proj, mu_proj, s3, rhs, lam):
+    """Per-class solves in the SAMPLE-SPAN basis, vmapped over a class
+    chunk — the few-shot/many-class regime (n ≪ d, e.g. the reference's
+    1000-class ImageNet config) where the dense path factors a d×d
+    system per class although every class covariance is rank ≤ n.
+
+    With Aᵀ = QR (reduced QR, computed once per feature block) the
+    per-class normal matrix lives entirely in span(Q):
+        jointXTX_c + λI = λI + Q H_c Qᵀ,
+        H_c = R diag(d_c) Rᵀ + Σⱼ s3ⱼ (Qᵀpⱼ)(Qᵀpⱼ)ᵀ,
+    with d_c[i] = (1−w)/n + w·1[i∈c]/n_c (the diagonal of
+    :func:`_class_sample_weights`) and pⱼ ∈ {pm, μ_c, μ_c−pm} — all in
+    span(Aᵀ), so the projection is exact. The full inverse is
+        x = Q (λI + H_c)⁻¹ Qᵀr + (r − QQᵀr)/λ,
+    but the ⊥ term is IDENTICALLY ZERO here and must not be computed:
+    rhs ∈ span(Q) by construction (jointXTR ∈ col(Aᵀ) and every Ws
+    update is a previous output of this function, i.e. ∈ span(Q), by
+    induction from Ws = 0) — so (r − QQᵀr) is pure rounding noise, and
+    dividing that noise by the ImageNet-scale λ=6e-5 produced weights
+    whose dominant component was noise orthogonal to the training rows:
+    invisible on train predictions, near-random held-out (caught by the
+    held-out assertion in the dual-vs-per-class test). The same 1/λ
+    amplification killed the plain Woodbury form of this solve. Hence:
+        x = Q (λI + H_c)⁻¹ Qᵀr,
+    O(n³) per class instead of O(d³), with no 1/λ-amplified term at all.
+
+    Q (d, n); R (n, n); dvec (C, n); pm_proj (n,) = Qᵀpm (projected ONCE
+    per block — not per class); mu_proj (C, n) = μ_c Q; s3 (3,);
+    rhs (C, d).
+    """
+    n = R.shape[0]
+    eye = jnp.eye(n, dtype=R.dtype)
+
+    def one(dv, mu_p, r):
+        Pp = jnp.stack([pm_proj, mu_p, mu_p - pm_proj])   # (3, n)
+        H = jnp.matmul(R * dv[None, :], R.T, precision="high")
+        H = H + jnp.einsum("j,jm,jo->mo", s3, Pp, Pp)
+        rp = jnp.matmul(Q.T, r, precision="high")         # (n,)
+        z = jnp.linalg.solve(H + lam * eye, rp)
+        return jnp.matmul(Q, z, precision="high")
+
+    return jax.vmap(one)(dvec, mu_proj, rhs)
 
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
@@ -100,6 +166,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         Y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
         return self.train_with_l2(blocks, Y)
 
+    @_f32_true
     def train_with_l2(self, blocks: Sequence, Y) -> BlockLinearMapper:
         """(parity: trainWithL2, BlockWeightedLeastSquares.scala:102-321)."""
         w = self.mixture_weight
@@ -125,13 +192,24 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         for _ in range(self.num_iter):
             for j, A in enumerate(blocks):
                 d = A.shape[1]
+                # Strategy: dense primal (d×d per class) when classes are
+                # well-populated; dual/Woodbury in sample space when
+                # n + 3 < d — the few-shot/many-class regime where the
+                # dense path would factor k rank-deficient d×d systems.
+                # The cached per-block Gram is pop_cov (d×d) for the
+                # dense path, AAᵀ (n×n) for the dual path — never both.
+                use_dual = lam > 0 and (n + 3) < d
                 if stats[j] is None:
                     pop_mean = jnp.mean(A, axis=0)
                     _, class_means = _class_stats(A, y_idx, k)
                     joint_means = w * class_means + (1 - w) * pop_mean
-                    pop_cov = (A.T @ A) / n - jnp.outer(pop_mean, pop_mean)
-                    stats[j] = (pop_cov, pop_mean, joint_means)
-                pop_cov, pop_mean, joint_means = stats[j]
+                    if use_dual:
+                        gram = jnp.linalg.qr(A.T)  # (Q (d,n), R (n,n))
+                    else:
+                        gram = (A.T @ A) / n - jnp.outer(pop_mean, pop_mean)
+                    stats[j] = (gram, pop_mean, joint_means)
+                gram_j, pop_mean, joint_means = stats[j]
+                pop_cov = gram_j  # dense path; dual path unpacks (Q, R)
                 pop_xtr = (A.T @ R) / n  # (d, k)
                 residual_mean = jnp.mean(R, axis=0)  # (k,)
 
@@ -143,29 +221,25 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     counts, 1.0
                 )  # (d, k): A_cᵀ r_c / n_c per class
 
+                if use_dual:
+                    s3 = jnp.asarray(
+                        [-(1 - w), -w, w * (1 - w)], dtype=jnp.float32
+                    )
+                    # dual systems are (n+3)² per class — far smaller than
+                    # d² — so batch many more classes per dispatch (bound:
+                    # ~256 MB of batched inner systems)
+                    C = max(
+                        1,
+                        min(k, self.class_chunk * 8,
+                            (1 << 26) // max((n + 3) ** 2, 1)),
+                    )
+                else:
+                    C = max(1, self.class_chunk)
                 delta_cols = []
-                C = max(1, self.class_chunk)
                 for c0 in range(0, k, C):
                     cs = slice(c0, min(c0 + C, k))
-                    # model-axis parallelism: the class dim of the masked
-                    # Grams and the batched per-class Cholesky shards over
-                    # MODEL_AXIS (each model-device owns a slice of
-                    # classes); a 1-wide model axis makes this a no-op
-                    mask = shard_classes(onehot[:, cs], axis=1)  # (n, C)
-                    grams = _chunk_grams(A, mask)  # (C, d, d)
-                    cnt = counts[cs][:, None, None]
                     mu_c = class_means[cs]  # (C, d)
-                    class_cov = grams / jnp.maximum(cnt, 1.0) - jnp.einsum(
-                        "cd,ce->cde", mu_c, mu_c
-                    )
                     mean_diff = mu_c - pop_mean  # (C, d)
-                    jointXTX = (
-                        (1 - w) * pop_cov
-                        + w * class_cov
-                        + w * (1 - w) * jnp.einsum(
-                            "cd,ce->cde", mean_diff, mean_diff
-                        )
-                    )
                     mean_mixture = (
                         (1 - w) * residual_mean[cs] + w * class_r_mean[cs]
                     )  # (C,)
@@ -175,6 +249,41 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         - joint_means[cs] * mean_mixture[:, None]
                     )  # (C, d)
                     rhs = jointXTR - lam * Ws[j][:, cs].T
+                    if use_dual:
+                        dvec = (1 - w) / n + w * onehot[:, cs].T \
+                            / jnp.maximum(counts[cs], 1.0)[:, None]  # (C, n)
+                        Qb, Rb = gram_j
+                        mu_proj = jnp.matmul(
+                            mu_c, Qb, precision="high"
+                        )  # (C, n)
+                        pm_proj = jnp.matmul(
+                            pop_mean, Qb, precision="high"
+                        )  # (n,)
+                        delta_cols.append(
+                            _dual_solve_chunk(
+                                Qb, Rb, shard_classes(dvec),
+                                pm_proj, shard_classes(mu_proj), s3,
+                                shard_classes(rhs), lam,
+                            )
+                        )
+                        continue
+                    # model-axis parallelism: the class dim of the masked
+                    # Grams and the batched per-class solves shards over
+                    # MODEL_AXIS (each model-device owns a slice of
+                    # classes); a 1-wide model axis makes this a no-op
+                    mask = shard_classes(onehot[:, cs], axis=1)  # (n, C)
+                    grams = _chunk_grams(A, mask)  # (C, d, d)
+                    cnt = counts[cs][:, None, None]
+                    class_cov = grams / jnp.maximum(cnt, 1.0) - jnp.einsum(
+                        "cd,ce->cde", mu_c, mu_c
+                    )
+                    jointXTX = (
+                        (1 - w) * pop_cov
+                        + w * class_cov
+                        + w * (1 - w) * jnp.einsum(
+                            "cd,ce->cde", mean_diff, mean_diff
+                        )
+                    )
                     delta_cols.append(
                         _batched_solve(
                             shard_classes(jointXTX), shard_classes(rhs), lam
@@ -238,6 +347,7 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         self.mixture_weight = mixture_weight
         self.num_features = num_features
 
+    @_f32_true
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
         X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
         Y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
@@ -269,6 +379,7 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(blocks, self.block_size, b=b)
 
 
+@_f32_true
 def solve_reweighted_l2(
     blocks: Sequence,
     y_zm,
@@ -349,6 +460,7 @@ class ReWeightedLeastSquaresEstimator(LabelEstimator):
         self.mixture_weight = mixture_weight
         self.num_features = num_features
 
+    @_f32_true
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
         X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
         Y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)

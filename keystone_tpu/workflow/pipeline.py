@@ -441,13 +441,18 @@ class FittedPipeline(Chainable):
         n = int(arr.shape[0])
         if n == 0:  # zero chunks would be produced; apply() handles empty
             return self.apply(data)
+        import jax.numpy as jnp
+
         outs = []
         for i in range(0, n, chunk_size):
             chunk = arr[i : i + chunk_size]
             pad = chunk_size - int(chunk.shape[0])
             if pad:
-                filler = np.repeat(np.asarray(chunk[:1]), pad, axis=0)
-                chunk = np.concatenate([np.asarray(chunk), filler], axis=0)
+                # pad on device — a host round trip here would add the
+                # transport's blocking-fetch latency to every call
+                chunk = jnp.concatenate(
+                    [chunk, jnp.repeat(chunk[:1], pad, axis=0)], axis=0
+                )
             out = self._compiled(chunk)
             if not hasattr(out, "shape"):
                 raise TypeError(
@@ -455,8 +460,6 @@ class FittedPipeline(Chainable):
                     "for gathered/tuple sinks"
                 )
             outs.append(out[: chunk_size - pad] if pad else out)
-        import jax.numpy as jnp
-
         return Dataset(
             outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0),
             batched=True,

@@ -95,6 +95,43 @@ def test_weighted_family_three_way_agreement_mixed_balance():
     np.testing.assert_allclose(pb, pr, rtol=5e-2, atol=5e-2)
 
 
+def test_block_weighted_dual_path_agrees_with_per_class():
+    """n + 3 < d engages the Woodbury/dual sample-space solve (the
+    reference's 1000-class ImageNet regime: few samples per class, wide
+    features). With a single block and one iteration the block update IS
+    the exact per-class system, so the dual result must match the
+    independent dense per-class implementation tightly — at both a
+    benign λ and the ImageNet-scale tiny λ that stresses the Woodbury
+    cancellation."""
+    rng = np.random.default_rng(11)
+    n, d, k = 48, 64, 6
+    y = np.repeat(np.arange(k), n // k)
+    rng.shuffle(y)
+    W = rng.standard_normal((d, k))
+    X = (rng.standard_normal((n, d)) + 0.5 * W.T[y]).astype(np.float32)
+    Y = -np.ones((n, k), dtype=np.float32)
+    Y[np.arange(n), y] = 1.0
+
+    # HELD-OUT rows are the load-bearing check: training rows lie in
+    # span(Q) and annihilate any weight-error component orthogonal to
+    # the data span — the exact error mode a 1/λ-amplified ⊥ term
+    # produces (invisible on train, near-random held-out).
+    X_test = rng.standard_normal((32, d)).astype(np.float32)
+    for lam in (0.5, 1e-4):
+        args = dict(lam=lam, mixture_weight=0.25)
+        dual = BlockWeightedLeastSquaresEstimator(d, 1, **args).fit(
+            Dataset.of(X), Dataset.of(Y)
+        )
+        exact = PerClassWeightedLeastSquaresEstimator(d, 1, **args).fit(
+            Dataset.of(X), Dataset.of(Y)
+        )
+        for batch in (X, X_test):
+            pd_ = np.asarray(dual.apply_batch(Dataset.of(batch)).to_array())
+            pe = np.asarray(exact.apply_batch(Dataset.of(batch)).to_array())
+            scale = np.abs(pe).max()
+            np.testing.assert_allclose(pd_, pe, rtol=2e-2, atol=2e-2 * scale)
+
+
 def test_reweighted_solver_single_block_is_exact():
     """With one block and one iteration the reweighted update IS the closed
     form (Gram cache + rhs reduce to the normal equations), pinning the
