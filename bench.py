@@ -820,10 +820,16 @@ def bench_imagenet_fv() -> dict:
             o = None
             for i in range(CHAIN):
                 # eps-vary the input so a memoizing transport can't replay
-                # (offset starts at 1: +0.0 would replay the warm-up input)
-                o = compiled(
-                    batch + np.float32(1e-6 * (trial * CHAIN + i + 1))
-                )
+                # (offset starts at 1: +0 would replay the warm-up input).
+                # The executable is dtype-specialized, so the perturbation
+                # must keep the batch dtype: +k wrapping uint8 pixels for
+                # byte images, +k*1e-6 for float images.
+                k_eps = trial * CHAIN + i + 1
+                if np.issubdtype(batch.dtype, np.integer):
+                    eps = np.asarray(k_eps, dtype=batch.dtype)
+                else:
+                    eps = np.asarray(1e-6 * k_eps, dtype=batch.dtype)
+                o = compiled(batch + eps)
             _fetch_scalar(o)
             fused_times.append((time.perf_counter() - t0) / CHAIN)
         t_fused = min(fused_times)
@@ -840,13 +846,17 @@ def bench_imagenet_fv() -> dict:
         # any-size serve through ONE executable (apply_chunked): the full
         # test set, whose size is not a multiple of the chunk, rides the
         # 64-row program — vs first_apply above, which recompiled the
-        # whole serve program at the test set's native shape
+        # whole serve program at the test set's native shape. Test set
+        # device-resident first (as in the fused phase) so steady times
+        # the program, not the tunnel upload.
+        te_dev = jax.device_put(te_i)
+        _fetch_scalar(te_dev)
         t0 = time.perf_counter()
-        o = fitted.apply_chunked(te_i, chunk_size=batch_n)
+        o = fitted.apply_chunked(te_dev, chunk_size=batch_n)
         _fetch_scalar(o.to_array())
         t_chunk_first = time.perf_counter() - t0
         t0 = time.perf_counter()
-        o = fitted.apply_chunked(te_i, chunk_size=batch_n)
+        o = fitted.apply_chunked(te_dev, chunk_size=batch_n)
         _fetch_scalar(o.to_array())
         t_chunk_steady = time.perf_counter() - t0
 

@@ -115,13 +115,17 @@ def _dual_solve_chunk(Q, R, dvec, pm_proj, mu_proj, s3, rhs, lam):
     n = R.shape[0]
     eye = jnp.eye(n, dtype=R.dtype)
 
+    # NOTE: no explicit precision= on any product here — an explicit
+    # precision="high" would OVERRIDE the _f32_true("highest") context
+    # the weighted family runs under (explicit args beat the context),
+    # silently reintroducing bf16_3x rounding next to the λ floor.
     def one(dv, mu_p, r):
         Pp = jnp.stack([pm_proj, mu_p, mu_p - pm_proj])   # (3, n)
-        H = jnp.matmul(R * dv[None, :], R.T, precision="high")
+        H = jnp.matmul(R * dv[None, :], R.T)
         H = H + jnp.einsum("j,jm,jo->mo", s3, Pp, Pp)
-        rp = jnp.matmul(Q.T, r, precision="high")         # (n,)
+        rp = jnp.matmul(Q.T, r)                           # (n,)
         z = jnp.linalg.solve(H + lam * eye, rp)
-        return jnp.matmul(Q, z, precision="high")
+        return jnp.matmul(Q, z)
 
     return jax.vmap(one)(dvec, mu_proj, rhs)
 
@@ -204,7 +208,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     _, class_means = _class_stats(A, y_idx, k)
                     joint_means = w * class_means + (1 - w) * pop_mean
                     if use_dual:
-                        gram = jnp.linalg.qr(A.T)  # (Q (d,n), R (n,n))
+                        gram = tuple(jnp.linalg.qr(A.T))  # (Q (d,n), R (n,n))
                     else:
                         gram = (A.T @ A) / n - jnp.outer(pop_mean, pop_mean)
                     stats[j] = (gram, pop_mean, joint_means)
@@ -225,6 +229,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     s3 = jnp.asarray(
                         [-(1 - w), -w, w * (1 - w)], dtype=jnp.float32
                     )
+                    # constant per block — projected once, not per chunk
+                    pm_proj = jnp.matmul(pop_mean, stats[j][0][0])
                     # dual systems are (n+3)² per class — far smaller than
                     # d² — so batch many more classes per dispatch (bound:
                     # ~256 MB of batched inner systems)
@@ -253,12 +259,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         dvec = (1 - w) / n + w * onehot[:, cs].T \
                             / jnp.maximum(counts[cs], 1.0)[:, None]  # (C, n)
                         Qb, Rb = gram_j
-                        mu_proj = jnp.matmul(
-                            mu_c, Qb, precision="high"
-                        )  # (C, n)
-                        pm_proj = jnp.matmul(
-                            pop_mean, Qb, precision="high"
-                        )  # (n,)
+                        mu_proj = jnp.matmul(mu_c, Qb)  # (C, n)
                         delta_cols.append(
                             _dual_solve_chunk(
                                 Qb, Rb, shard_classes(dvec),
@@ -426,21 +427,21 @@ def solve_reweighted_l2(
 
 @nestable_jit
 def _weighted_gram(Aj, mj, b):
+    # no explicit precision= — the _f32_true context governs (an explicit
+    # "high" would override it and keep this at bf16_3x)
     Ajc = Aj - mj
-    return jnp.matmul(Ajc.T, Ajc * b[:, None], precision="high")
+    return jnp.matmul(Ajc.T, Ajc * b[:, None])
 
 
 @nestable_jit
 def _reweighted_block_update(Aj, mj, G, Wj_old, R, y_zm, b, reg):
     Ajc = Aj - mj
     # remove this block's contribution from the weighted residual
-    xw_old = jnp.matmul(Ajc, Wj_old, precision="high")
+    xw_old = jnp.matmul(Ajc, Wj_old)
     R_wo = R - xw_old * b[:, None]
-    rhs = jnp.matmul(
-        Ajc.T, y_zm * b[:, None] - R_wo, precision="high"
-    )
+    rhs = jnp.matmul(Ajc.T, y_zm * b[:, None] - R_wo)
     Wj = solve_spd(G, rhs, reg)
-    R = R_wo + jnp.matmul(Ajc, Wj, precision="high") * b[:, None]
+    R = R_wo + jnp.matmul(Ajc, Wj) * b[:, None]
     return Wj, R
 
 

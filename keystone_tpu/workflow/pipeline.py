@@ -431,8 +431,6 @@ class FittedPipeline(Chainable):
         featurizers, linear models, classifiers). Batch-coupled nodes
         must go through :meth:`apply`.
         """
-        import numpy as np
-
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
         if self._compiled is None:

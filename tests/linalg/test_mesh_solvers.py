@@ -92,6 +92,36 @@ def test_weighted_estimator_on_mesh_matches_per_class_oracle(dm_mesh):
         np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
 
 
+def test_weighted_dual_path_on_mesh_matches_per_class_oracle(dm_mesh):
+    """The few-shot/many-class DUAL solve (n + 3 < d: QR + sample-span
+    systems) on the mesh, with its per-class systems sharded over
+    MODEL_AXIS — the ImageNet 1000-class regime's multi-chip story."""
+    rng = np.random.default_rng(9)
+    n, d, k = 24, 48, 8  # n + 3 < d → dual path engages
+    y = np.repeat(np.arange(k), n // k)
+    rng.shuffle(y)
+    X = (rng.standard_normal((n, d)) + 0.7 * rng.standard_normal((d, k)).T[y]
+         ).astype(np.float32)
+    Y = -np.ones((n, k), dtype=np.float32)
+    Y[np.arange(n), y] = 1.0
+    X_test = rng.standard_normal((16, d)).astype(np.float32)
+    with use_mesh(dm_mesh):
+        Xs = shard_batch(X)
+        assert len(Xs.sharding.device_set) == 8
+        dual = BlockWeightedLeastSquaresEstimator(
+            block_size=d, num_iter=1, lam=1e-3, mixture_weight=0.25,
+            class_chunk=k,
+        ).fit(Dataset.of(Xs), Dataset.of(Y))
+        oracle = PerClassWeightedLeastSquaresEstimator(
+            block_size=d, num_iter=1, lam=1e-3, mixture_weight=0.25
+        ).fit(Dataset.of(X), Dataset.of(Y))
+        # held-out rows: train rows cannot see span-orthogonal error
+        got = np.asarray(dual.trace_batch(X_test))
+        want = np.asarray(oracle.trace_batch(X_test))
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2 * scale)
+
+
 def test_block_ls_estimator_fit_on_sharded_rows(dm_mesh):
     rng = np.random.default_rng(2)
     n, d, k = 64, 16, 3

@@ -62,7 +62,8 @@ def image_tar(tmp_path):
 def test_decode_rules():
     rng = np.random.default_rng(1)
     ok = decode_image_bytes(_jpeg_bytes(rng.integers(0, 255, (50, 40, 3))))
-    assert ok.shape == (50, 40, 3) and ok.dtype == np.float32
+    # uint8 ingestion: pixels stay bytes until the device casts
+    assert ok.shape == (50, 40, 3) and ok.dtype == np.uint8
     assert decode_image_bytes(b"garbage") is None
     small = _jpeg_bytes(rng.integers(0, 255, (MIN_DIM - 1, 100, 3)))
     assert decode_image_bytes(small) is None
