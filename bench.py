@@ -990,9 +990,47 @@ def bench_text() -> dict:
         solve_attempts.append(time.perf_counter() - t0)
     t_solve = min(solve_attempts)
 
+    # native C++ hashing runtime (keystone_tpu/native): the rolling
+    # n-gram HashingTF over the same corpus, native vs forced-Python,
+    # identity-checked — the host-runtime analogue of the reference's
+    # native layer, measured not claimed
+    from keystone_tpu import native as ks_native
+    from keystone_tpu.nodes.nlp import NGramsHashingTF
+
+    hashing_tf = {"native_available": ks_native.get_lib() is not None}
+    ntf = NGramsHashingTF([1, 2], 100_000)
+    t0 = time.perf_counter()
+    h_native = ntf.apply_batch(docs)
+    hashing_tf["seconds_native"] = round(time.perf_counter() - t0, 3)
+    prior_no_native = os.environ.get("KEYSTONE_NO_NATIVE")
+    os.environ["KEYSTONE_NO_NATIVE"] = "1"
+    try:
+        t0 = time.perf_counter()
+        h_py = ntf.apply_batch(docs)
+        hashing_tf["seconds_python"] = round(time.perf_counter() - t0, 3)
+    finally:
+        if prior_no_native is None:
+            del os.environ["KEYSTONE_NO_NATIVE"]
+        else:
+            os.environ["KEYSTONE_NO_NATIVE"] = prior_no_native
+    hashing_tf["speedup"] = round(
+        hashing_tf["seconds_python"] / max(hashing_tf["seconds_native"], 1e-9), 1
+    )
+    hashing_tf["identical"] = bool(
+        np.array_equal(
+            np.asarray(h_native.payload.indices),
+            np.asarray(h_py.payload.indices),
+        )
+        and np.allclose(
+            np.asarray(h_native.payload.values),
+            np.asarray(h_py.payload.values),
+        )
+    )
+
     t_feat = t_tok + t_packed
     ratio = t_feat / max(t_solve, 1e-9)
     return {
+        "ngrams_hashing_tf_native": hashing_tf,
         "docs_per_sec_featurize": round(n_docs / t_feat, 1),
         "phases": {
             "tokenize": round(t_tok, 3),

@@ -1,0 +1,158 @@
+"""Native (C++) host-runtime accelerators, ctypes-loaded, always optional.
+
+The TPU compute path is XLA/Pallas; this package is the native runtime
+around it for host-side hot loops that cannot ride the device — the
+counterpart of the reference's native layer (its `src/main/cpp` JNI
+wrappers front VLFeat/enceval image code, which THIS framework subsumes
+on-device; what remains host-bound here is text featurization's
+per-character hashing). Design rules:
+
+* built lazily with ``g++`` on first use, cached next to the source
+  keyed by a source hash; no build system, no pybind11 — plain
+  ``extern "C"`` + ctypes;
+* bit-exact with the pure-Python implementations (asserted in
+  tests/nodes/test_native_hashing.py) — the Python path is the spec,
+  the native path is the speed;
+* every caller falls back to pure Python when the toolchain or build is
+  unavailable (``KEYSTONE_NO_NATIVE=1`` forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(_HERE, "hashing.cpp")
+    with open(src, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    build_dir = os.path.join(_HERE, "_build")
+    so_path = os.path.join(build_dir, f"libkshash-{digest}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(build_dir, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            logger.warning(
+                "native hashing build failed (falling back to Python): %s",
+                proc.stderr[-500:],
+            )
+            return None
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    lib = ctypes.CDLL(so_path)
+    lib.ks_java_string_hash_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.ks_java_string_hash_batch.restype = None
+    lib.ks_ngram_hash_features_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.ks_ngram_hash_features_batch.restype = ctypes.c_int64
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (no toolchain / disabled)."""
+    global _LIB, _LIB_FAILED
+    if os.environ.get("KEYSTONE_NO_NATIVE"):
+        return None
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is None and not _LIB_FAILED:
+            try:
+                _LIB = _build_and_load()
+            except Exception as e:  # toolchain quirks → Python fallback
+                logger.warning("native hashing unavailable: %s", e)
+                _LIB = None
+            if _LIB is None:
+                _LIB_FAILED = True
+    return _LIB
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def java_string_hash_batch(tokens: Sequence[str]) -> Optional[np.ndarray]:
+    """(n,) int32 java hashCodes of ``tokens``, or None if native is
+    unavailable. Bit-exact with hashing.java_string_hash (which matches
+    the ord()-codepoint semantics of the Python loop)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    lens = np.fromiter(
+        (len(t) for t in tokens), dtype=np.int64, count=len(tokens)
+    )
+    offsets = np.zeros(len(tokens) + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    blob = "".join(tokens)
+    try:
+        encoded = blob.encode("utf-32-le")
+    except UnicodeEncodeError:
+        # lone surrogates (e.g. surrogateescape-decoded bytes) cannot be
+        # UTF-32-encoded; decline so callers take the ord()-based Python
+        # path, which handles them
+        return None
+    cps = np.frombuffer(encoded, dtype=np.uint32)
+    out = np.empty(len(tokens), dtype=np.int32)
+    lib.ks_java_string_hash_batch(
+        _ptr(cps), _ptr(offsets), len(tokens), _ptr(out)
+    )
+    return out
+
+
+def ngram_hash_features_batch(
+    token_hashes: np.ndarray,
+    doc_offsets: np.ndarray,
+    min_order: int,
+    max_order: int,
+    num_features: int,
+    seq_seed: int,
+):
+    """Rolling n-gram feature indices (NGramsHashingTF's inner loops) as
+    ``(flat_features int32, out_offsets int64)`` with out_offsets
+    delimiting each doc's slice — or None if native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    token_hashes = np.ascontiguousarray(token_hashes, dtype=np.int32)
+    doc_offsets = np.ascontiguousarray(doc_offsets, dtype=np.int64)
+    n_docs = len(doc_offsets) - 1
+    doc_lens = np.diff(doc_offsets)
+    # features per doc: Σ_i (min(max_order, n−i) − min_order + 1) over
+    # valid starts — closed form via counts of each achievable order
+    counts = np.zeros(n_docs, dtype=np.int64)
+    for order in range(min_order, max_order + 1):
+        counts += np.maximum(doc_lens - order + 1, 0)
+    out_offsets = np.zeros(n_docs + 1, dtype=np.int64)
+    np.cumsum(counts, out=out_offsets[1:])
+    out = np.empty(int(out_offsets[-1]), dtype=np.int32)
+    written = lib.ks_ngram_hash_features_batch(
+        _ptr(token_hashes), _ptr(doc_offsets), n_docs,
+        min_order, max_order, num_features,
+        ctypes.c_uint32(seq_seed & 0xFFFFFFFF), _ptr(out_offsets), _ptr(out),
+    )
+    if written != len(out):  # pragma: no cover - count model mismatch
+        raise AssertionError(
+            f"native n-gram feature count {written} != expected {len(out)}"
+        )
+    return out, out_offsets

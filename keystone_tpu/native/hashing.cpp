@@ -1,0 +1,97 @@
+// Native batch hashing for the text featurization host path.
+//
+// Bit-exact counterparts of keystone_tpu/nodes/nlp/hashing.py (which is
+// itself bit-exact with the reference's Scala `.##` / MurmurHash3.seqHash
+// — HashingTF.scala:15-32, NGramsHashingTF.scala:25-146). The Python
+// loops hash per character / per n-gram position in the interpreter; the
+// corpus-level batch forms here do the same arithmetic over flat arrays.
+// Strings arrive as UTF-32 codepoint arrays (matching the Python
+// implementation's ord()-based loop).
+//
+// Built by keystone_tpu/native/__init__.py with g++ at first use and
+// loaded via ctypes; everything stays available in pure Python when no
+// compiler is present.
+
+#include <cstdint>
+
+namespace {
+
+inline uint32_t rotl32(uint32_t x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+
+inline uint32_t mix(uint32_t h, uint32_t k) {
+  k *= 0xCC9E2D51u;
+  k = rotl32(k, 15);
+  k *= 0x1B873593u;
+  h ^= k;
+  h = rotl32(h, 13);
+  return h * 5u + 0xE6546B64u;
+}
+
+inline int32_t finalize(uint32_t h, uint32_t length) {
+  h ^= length;
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return (int32_t)h;
+}
+
+inline int32_t non_negative_mod(int32_t x, int32_t mod) {
+  int32_t r = x % mod;
+  return r < 0 ? r + mod : r;
+}
+
+}  // namespace
+
+extern "C" {
+
+// java.lang.String.hashCode over n strings packed as UTF-32 codepoints.
+// offsets has n+1 entries delimiting each string in cps.
+void ks_java_string_hash_batch(const uint32_t* cps, const int64_t* offsets,
+                               int64_t n, int32_t* out) {
+  for (int64_t s = 0; s < n; ++s) {
+    uint32_t h = 0;
+    for (int64_t i = offsets[s]; i < offsets[s + 1]; ++i) {
+      h = h * 31u + cps[i];
+    }
+    out[s] = (int32_t)h;
+  }
+}
+
+// The rolling n-gram feature stream of NGramsHashingTF.apply: for every
+// start position, hash the min_order-gram, then extend one token at a
+// time up to max_order, emitting non_negative_mod(finalize(h, order), F)
+// at each order. doc_offsets (n_docs+1) delimits token_hashes per doc;
+// out_offsets (n_docs+1) delimits the (precomputed) per-doc output
+// counts. Returns total features written.
+int64_t ks_ngram_hash_features_batch(
+    const int32_t* token_hashes, const int64_t* doc_offsets, int64_t n_docs,
+    int32_t min_order, int32_t max_order, int32_t num_features,
+    uint32_t seq_seed, const int64_t* out_offsets, int32_t* out) {
+  int64_t written = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    const int32_t* th = token_hashes + doc_offsets[d];
+    const int64_t n = doc_offsets[d + 1] - doc_offsets[d];
+    int32_t* w = out + out_offsets[d];
+    for (int64_t i = 0; i + min_order <= n; ++i) {
+      uint32_t h = seq_seed;
+      for (int64_t j = i; j < i + min_order; ++j) {
+        h = mix(h, (uint32_t)th[j]);
+      }
+      *w++ = non_negative_mod(finalize(h, (uint32_t)min_order),
+                              num_features);
+      for (int32_t order = min_order + 1;
+           order <= max_order && i + order <= n; ++order) {
+        h = mix(h, (uint32_t)th[i + order - 1]);
+        *w++ = non_negative_mod(finalize(h, (uint32_t)order), num_features);
+      }
+    }
+    written += w - (out + out_offsets[d]);
+  }
+  return written;
+}
+
+}  // extern "C"

@@ -99,6 +99,86 @@ def _non_negative_mod(x: int, mod: int) -> int:
     return r + mod if r < 0 else r
 
 
+def _flat_string_hashes(docs):
+    """(hashes int32, doc_offsets int64) for all-string docs via the
+    native hasher, else None (non-string terms use scala_hash's type
+    dispatch — Python path)."""
+    from ... import native
+
+    if native.get_lib() is None:
+        return None
+    flat: List[str] = []
+    lens: List[int] = []
+    for doc in docs:
+        for t in doc:
+            if type(t) is not str:
+                return None
+        flat.extend(doc)
+        lens.append(len(doc))
+    import numpy as np
+
+    hashes = native.java_string_hash_batch(flat)
+    if hashes is None:
+        return None
+    doc_offsets = np.zeros(len(lens) + 1, dtype=np.int64)
+    np.cumsum(np.asarray(lens, dtype=np.int64), out=doc_offsets[1:])
+    return hashes, doc_offsets
+
+
+def _tf_sparse_from_features(feats, doc_offsets, n_docs, num_features):
+    """Flat per-position feature indices → padded SparseRows, fully
+    vectorized: one corpus-level unique over (doc, feature) keys replaces
+    the per-doc dict counting (rows come out sorted by feature id, the
+    dict path's ``sorted(tf.items())`` order)."""
+    import numpy as np
+
+    from .packed_features import _to_sparse_rows
+
+    counts = np.diff(doc_offsets)
+    doc_ids = np.repeat(np.arange(n_docs, dtype=np.int64), counts)
+    key = doc_ids * num_features + feats.astype(np.int64)
+    u, c = np.unique(key, return_counts=True)
+    return _to_sparse_rows(
+        u // num_features, (u % num_features).astype(np.int64),
+        c.astype(np.float32), n_docs, num_features,
+    )
+
+
+def _native_string_tf_sparse(docs, num_features: int):
+    """Batch HashingTF → SparseRows via the native hasher, or None."""
+    hashed = _flat_string_hashes(docs)
+    if hashed is None:
+        return None
+    import numpy as np
+
+    hashes, doc_offsets = hashed
+    feats = hashes.astype(np.int64) % num_features  # python-sign modulo
+    return _tf_sparse_from_features(
+        feats, doc_offsets, len(docs), num_features
+    )
+
+
+def _native_ngram_tf_sparse(docs, min_order: int, max_order: int,
+                            num_features: int):
+    """Batch NGramsHashingTF → SparseRows via the native rolling hasher,
+    or None."""
+    from ... import native
+
+    hashed = _flat_string_hashes(docs)
+    if hashed is None:
+        return None
+    hashes, doc_offsets = hashed
+    res = native.ngram_hash_features_batch(
+        hashes, doc_offsets, min_order, max_order, num_features, SEQ_SEED
+    )
+    if res is None:
+        return None
+    flat_feats, out_offsets = res
+    return _tf_sparse_from_features(
+        flat_feats, out_offsets, len(docs), num_features
+    )
+
+
 class HashingTF(Transformer):
     """Term sequence → sparse term-frequency row by the hashing trick
     (parity: HashingTF.scala:15-32)."""
@@ -115,10 +195,13 @@ class HashingTF(Transformer):
 
     def apply_batch(self, data) -> Dataset:
         data = Dataset.of(data)
-        rows = [self.apply(doc) for doc in data]
-        return Dataset(
-            SparseRows.from_pairs(rows, self.num_features), batched=True
-        )
+        docs = [list(doc) for doc in data]
+        sr = _native_string_tf_sparse(docs, self.num_features)
+        if sr is None:
+            sr = SparseRows.from_pairs(
+                [self.apply(doc) for doc in docs], self.num_features
+            )
+        return Dataset(sr, batched=True)
 
 
 class NGramsHashingTF(Transformer):
@@ -159,7 +242,12 @@ class NGramsHashingTF(Transformer):
 
     def apply_batch(self, data) -> Dataset:
         data = Dataset.of(data)
-        rows = [self.apply(doc) for doc in data]
-        return Dataset(
-            SparseRows.from_pairs(rows, self.num_features), batched=True
+        docs = [list(doc) for doc in data]
+        sr = _native_ngram_tf_sparse(
+            docs, self.min_order, self.max_order, self.num_features
         )
+        if sr is None:
+            sr = SparseRows.from_pairs(
+                [self.apply(doc) for doc in docs], self.num_features
+            )
+        return Dataset(sr, batched=True)

@@ -1,0 +1,111 @@
+"""Native hashing accelerator: bit-exactness against the pure-Python
+reference implementation (which is itself bit-exact with the Scala
+`.##`/seqHash family — the known-value tests live in test_nlp.py), plus
+the fallback contract.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import native
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.nlp.hashing import (
+    HashingTF,
+    NGramsHashingTF,
+    java_string_hash,
+)
+
+
+def _random_tokens(rng, n):
+    pieces = ["a", "bc", "ω", "λx", "naïve", "日本",
+              "", "Z" * 40, "0", "\x00x"]
+    return [
+        "".join(rng.choice(pieces, size=rng.integers(1, 4)))
+        for _ in range(n)
+    ]
+
+
+def _rows(sr):
+    """padded SparseRows → per-row sorted (index, value) pair lists (the
+    HashingTF counts are >= 1, so value != 0 exactly marks real entries)."""
+    idx = np.asarray(sr.indices)
+    val = np.asarray(sr.values)
+    out = []
+    for i in range(idx.shape[0]):
+        keep = val[i] != 0
+        out.append(
+            sorted(zip(idx[i][keep].tolist(), val[i][keep].tolist()))
+        )
+    return out
+
+
+needs_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="no C++ toolchain available"
+)
+
+
+@needs_native
+def test_native_java_hash_bit_exact():
+    rng = np.random.default_rng(0)
+    tokens = _random_tokens(rng, 500) + ["", "a", "\x00"]
+    got = native.java_string_hash_batch(tokens)
+    want = np.asarray([java_string_hash(t) for t in tokens], dtype=np.int32)
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_native_hashing_tf_matches_python():
+    rng = np.random.default_rng(1)
+    docs = [
+        _random_tokens(rng, int(rng.integers(0, 30))) for _ in range(40)
+    ]
+    tf = HashingTF(257)
+    batch = tf.apply_batch(Dataset.from_items(docs))  # native path
+    for row_pairs, doc in zip(_rows(batch.payload), docs):
+        assert row_pairs == tf.apply(doc)  # pure-Python per-doc path
+
+
+@needs_native
+@pytest.mark.parametrize("orders", [(1, 1), (1, 3), (2, 3)])
+def test_native_ngrams_hashing_tf_matches_python(orders):
+    rng = np.random.default_rng(2)
+    docs = [
+        _random_tokens(rng, int(rng.integers(0, 12))) for _ in range(30)
+    ]
+    mn, mx = orders
+    tf = NGramsHashingTF(list(range(mn, mx + 1)), 1023)
+    batch = tf.apply_batch(Dataset.from_items(docs))
+    for row_pairs, doc in zip(_rows(batch.payload), docs):
+        assert row_pairs == tf.apply(doc)
+
+
+def test_non_string_terms_take_python_path():
+    # int/tuple terms use scala_hash's type dispatch — the native batch
+    # must decline, and results still match the per-doc path
+    docs = [[1, 2, ("a", "b")], ["x", 3]]
+    tf = HashingTF(97)
+    batch = tf.apply_batch(Dataset.from_items(docs))
+    for row_pairs, doc in zip(_rows(batch.payload), docs):
+        assert row_pairs == tf.apply(doc)
+
+
+def test_fallback_when_native_disabled(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_NO_NATIVE", "1")
+    assert native.get_lib() is None
+    docs = [["a", "b", "a"], ["c"]]
+    tf = NGramsHashingTF([1, 2], 64)
+    batch = tf.apply_batch(Dataset.from_items(docs))
+    for row_pairs, doc in zip(_rows(batch.payload), docs):
+        assert row_pairs == tf.apply(doc)
+
+
+def test_lone_surrogate_tokens_fall_back_to_python():
+    """Tokens with lone surrogates (surrogateescape-decoded bytes) cannot
+    be UTF-32-encoded — the native batch must decline, not raise, and the
+    ord()-based Python path must produce the row."""
+    bad = b"caf\xff".decode("utf-8", errors="surrogateescape")
+    docs = [["ok", bad], [bad]]
+    tf = HashingTF(101)
+    batch = tf.apply_batch(Dataset.from_items(docs))
+    for row_pairs, doc in zip(_rows(batch.payload), docs):
+        assert row_pairs == tf.apply(doc)
