@@ -258,11 +258,76 @@ def bench_solvers() -> dict:
             "chunks), synthetic f32 data"
         ),
     }
+    # -- Amazon-shaped sparse LBFGS (the last solver-table family) ------
+    out["amazon_lbfgs_sparse_d16384"] = _bench_sparse_lbfgs(scale)
+
     out["solver_accuracy_ok"] = all(
         v.get("accuracy_ok", True)
         for v in out.values() if isinstance(v, dict)
     )
     return out
+
+
+def _bench_sparse_lbfgs(scale: int) -> dict:
+    """Sparse LBFGS at the reference's Amazon shape (VERDICT r3 #1's
+    remaining family): d=16384 sparse text features, binary labels
+    (scripts/solver-comparisons-final.csv:13 — 52.3 s / 11.4% train err
+    on 16x r3.4xlarge). Synthetic data is planted: rows have ~85 active
+    features (Amazon-review token counts), labels are sign(X·w* + noise)
+    with the noise level chosen to flip ~10% of labels — the measured
+    flip rate is the quality floor, and the fitted model's train 0/1
+    error must land near it (a broken gradient/optimizer lands far
+    above)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.data.sparse import SparseRows
+    from keystone_tpu.nodes.learning.lbfgs import SparseLBFGSwithL2
+
+    n, d, nnz = 262144 // scale, 16384 // scale, 85
+    rng = np.random.default_rng(17)
+    idx = rng.integers(0, d, size=(n, nnz), dtype=np.int64).astype(np.int32)
+    val = rng.standard_normal((n, nnz)).astype(np.float32)
+    X = SparseRows(jnp.asarray(idx), jnp.asarray(val), d)
+    w_star = (rng.standard_normal(d) / np.sqrt(nnz)).astype(np.float32)
+    margin = np.asarray(X.matmul(jnp.asarray(w_star[:, None])))[:, 0]
+    noise = 0.65 * np.std(margin) * rng.standard_normal(n)
+    y = np.sign(margin + noise).astype(np.float32)
+    y[y == 0] = 1.0
+    flip_rate = float((np.sign(margin) != y).mean())
+    B = Dataset.of(y[:, None])
+
+    times = []
+    model = None
+    for trial in range(2):  # attempt 1 includes compiles
+        est = SparseLBFGSwithL2(
+            convergence_tol=1e-5, num_iterations=50,
+            reg_param=1e-7 * (1 + 1e-6 * trial),
+        )
+        t0 = time.perf_counter()
+        model_i = est.fit(Dataset(X, batched=True), B)
+        _fetch_scalar(model_i.W)
+        times.append(time.perf_counter() - t0)
+        if model is None:
+            model = model_i
+    pred = np.asarray(X.matmul(jnp.asarray(model.W)))[:, 0]
+    train_err = float((np.sign(pred) != y).mean())
+    return {
+        "n": n, "d": d, "nnz_per_row": nnz, "iterations": 50,
+        "seconds_steady": round(min(times), 3),
+        "seconds_attempts": [round(t, 3) for t in times],
+        "train_err_pct": round(100 * train_err, 2),
+        "planted_flip_rate_pct": round(100 * flip_rate, 2),
+        "accuracy_ok": bool(train_err < 1.5 * flip_rate + 0.005),
+        "reference": (
+            "Amazon LBFGS (sparse) d=16384: 52.3 s / 11.4% train err on "
+            "16x r3.4xlarge (scripts/solver-comparisons-final.csv:13); "
+            "this row is one chip, synthetic planted-noise data with the "
+            "flip rate as the quality floor"
+        ),
+    }
 
 
 def bench_voc_real_codebook() -> dict:

@@ -102,12 +102,26 @@ class SparseRows:
 
     # -- consumers -------------------------------------------------------
 
-    def to_dense(self) -> jnp.ndarray:
-        """(n, d) dense scatter. Prefer matmul() when d is large."""
+    def to_dense(self, dtype=None) -> jnp.ndarray:
+        """(n, d) dense scatter. ``dtype`` bounds the target's memory.
+
+        NOTE for large inputs: XLA's TPU scatter pads its index/update
+        operands ~66×, so one 25M-update scatter allocates 10+ GB of
+        pure padding. Callers densifying big matrices should scatter row
+        SLICES (``row_slice``) and consume each block before the next —
+        see SparseLBFGSwithL2's streamed Gram accumulation."""
         n, m = self.indices.shape
-        out = jnp.zeros((n, self.num_features), dtype=self.values.dtype)
+        dtype = dtype or self.values.dtype
+        out = jnp.zeros((n, self.num_features), dtype=dtype)
         rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
-        return out.at[rows, self.indices].add(self.values)
+        return out.at[rows, self.indices].add(self.values.astype(dtype))
+
+    def row_slice(self, start: int, stop: int) -> "SparseRows":
+        """A row-range view (shared buffers, sliced padded arrays)."""
+        return SparseRows(
+            self.indices[start:stop], self.values[start:stop],
+            self.num_features,
+        )
 
     def matmul(self, W) -> jnp.ndarray:
         """X @ W without densifying: gather W rows by feature index, weight

@@ -172,9 +172,17 @@ class LogisticRegressionEstimator(LabelEstimator):
         if isinstance(data.payload, SparseRows):
             X = data.payload
             W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=jnp.float32)
-            vag = jax.jit(
-                lambda w: _sparse_logistic_value_and_grad(w, X, onehot, lam)
-            )
+            # operands ride vag_args, not closures: a closed-over design
+            # matrix becomes an HLO constant shipped to the compile
+            # service (see minimize_lbfgs)
+            num_features = X.num_features
+
+            def vag(w, idx, vals, onehot, lam):
+                return _sparse_logistic_value_and_grad(
+                    w, SparseRows(idx, vals, num_features), onehot, lam
+                )
+
+            vag_args = (X.indices, X.values, onehot, lam)
         else:
             if not data.is_batched:
                 import scipy.sparse as sp
@@ -192,14 +200,14 @@ class LogisticRegressionEstimator(LabelEstimator):
             X = shard_batch(X)
             onehot_dev = shard_batch(onehot)
             W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=jnp.float32)
-            vag = lambda w: _logistic_value_and_grad(  # noqa: E731
-                w, X, onehot_dev, lam
-            )
+            vag = _logistic_value_and_grad
+            vag_args = (X, onehot_dev, lam)
         W = minimize_lbfgs(
             vag,
             W0,
             max_iterations=self.num_iters,
             convergence_tol=self.convergence_tol,
+            vag_args=vag_args,
         )
         return LogisticRegressionModel(W)
 

@@ -324,3 +324,33 @@ def test_lbfgs_with_l2_matches_closed_form_ridge():
     # loss = ||XW−Y||²/(2n) + λ/2‖W‖² → (XᵀX/n + λI) W = XᵀY/n
     W = np.linalg.solve(X.T @ X / n + lam * np.eye(d), X.T @ Y / n)
     np.testing.assert_allclose(np.asarray(model.W), W, rtol=2e-2, atol=2e-2)
+
+
+def test_sparse_lbfgs_strategies_agree():
+    """The two sparse-LBFGS execution strategies — precomputed-Gram
+    quadratic and the gather/scatter path — must fit the same model
+    (gram_budget_bytes picks the strategy)."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.data.sparse import SparseRows
+    from keystone_tpu.nodes.learning.lbfgs import SparseLBFGSwithL2
+
+    rng = np.random.default_rng(21)
+    n, d, k = 256, 96, 2
+    dense = (rng.random((n, d)) < 0.1) * rng.standard_normal((n, d))
+    X = SparseRows.from_scipy(sp.csr_matrix(dense.astype(np.float32)))
+    Y = np.sign(rng.standard_normal((n, k))).astype(np.float32)
+
+    def fit(budget):
+        # tight tolerance: both strategies must reach the same optimum,
+        # not just wander near it on different trajectories
+        est = SparseLBFGSwithL2(
+            reg_param=1e-3, num_iterations=200, convergence_tol=1e-9,
+            gram_budget_bytes=budget,
+        )
+        m = est.fit(Dataset(X, batched=True), Dataset.of(Y))
+        return np.asarray(m.W)
+
+    w_gram = fit(1e9)   # d x d Gram fits easily
+    w_gather = fit(0)   # Gram disabled -> gather/scatter path
+    np.testing.assert_allclose(w_gather, w_gram, rtol=2e-2, atol=2e-3)
