@@ -3,9 +3,10 @@
 Parity: nodes/learning/LBFGS.scala:14-281 (runLBFGS/CostFun/DenseLBFGSwithL2/
 SparseLBFGSwithL2) + Gradient.scala:10-119. The reference computes
 per-partition batched gradients, treeReduces them to the driver and drives
-Breeze's LBFGS; here the full gradient is one jit program (per-shard GEMM +
-psum over ICI for row-sharded data) and the L-BFGS two-loop recursion +
-backtracking line search run host-side on device arrays.
+Breeze's LBFGS; here the ENTIRE optimization — gradients (per-shard GEMM +
+psum over ICI for row-sharded data), two-loop recursion, line search, and
+convergence test — is one compiled ``lax.while_loop`` program (see
+:func:`minimize_lbfgs`).
 
 Loss (CostFun, LBFGS.scala:69-123):
   f(W) = Σ ½‖AW − B‖² / n + ½·λ‖W‖²,  ∇f = Aᵀ(AW−B)/n + λW.

@@ -354,3 +354,62 @@ def test_sparse_lbfgs_strategies_agree():
     w_gram = fit(1e9)   # d x d Gram fits easily
     w_gather = fit(0)   # Gram disabled -> gather/scatter path
     np.testing.assert_allclose(w_gather, w_gram, rtol=2e-2, atol=2e-3)
+
+
+def test_minimize_lbfgs_quadratic_exact():
+    """On a strictly convex quadratic the compiled L-BFGS must reach the
+    analytic optimum (pins the two-loop recursion + line search)."""
+    from keystone_tpu.nodes.learning.lbfgs import minimize_lbfgs
+
+    rng = np.random.default_rng(5)
+    d = 24
+    M = rng.standard_normal((d, d)).astype(np.float32)
+    H = M @ M.T + 0.5 * np.eye(d, dtype=np.float32)
+    b = rng.standard_normal(d).astype(np.float32)
+
+    def vag(w, H, b):
+        Hw = H @ w
+        return 0.5 * jnp.vdot(w, Hw) - jnp.vdot(b, w), Hw - b
+
+    w = minimize_lbfgs(
+        vag, np.zeros(d, np.float32), max_iterations=100,
+        convergence_tol=1e-12, vag_args=(jnp.asarray(H), jnp.asarray(b)),
+    )
+    want = np.linalg.solve(H.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(w), want, rtol=1e-3, atol=1e-3)
+
+
+def test_minimize_lbfgs_ill_scaled_and_badly_started():
+    """Poor scaling exercises the memory/γ machinery; a far-off start
+    exercises backtracking (step 1 overshoots badly at first)."""
+    from keystone_tpu.nodes.learning.lbfgs import minimize_lbfgs
+
+    # condition number 1e2: curvature-aware enough to stress the memory
+    # while staying above the f32 |Δf| convergence floor
+    scales = jnp.asarray(
+        np.logspace(0, 2, 16).astype(np.float32)
+    )
+
+    def vag(w, scales):
+        return 0.5 * jnp.sum(scales * w * w), scales * w
+
+    w0 = np.full(16, 50.0, np.float32)
+    w = minimize_lbfgs(
+        vag, w0, max_iterations=200, convergence_tol=1e-12,
+        vag_args=(scales,),
+    )
+    assert float(jnp.max(jnp.abs(w))) < 5e-2
+
+
+def test_minimize_lbfgs_handles_flat_objective():
+    """A constant objective (zero gradient everywhere) must terminate
+    and return the start point, not NaN or loop forever."""
+    from keystone_tpu.nodes.learning.lbfgs import minimize_lbfgs
+
+    def vag(w):
+        return jnp.float32(1.0), jnp.zeros_like(w)
+
+    w0 = np.ones(4, np.float32)
+    w = minimize_lbfgs(vag, w0, max_iterations=30)
+    np.testing.assert_allclose(np.asarray(w), w0)
+    assert np.all(np.isfinite(np.asarray(w)))
