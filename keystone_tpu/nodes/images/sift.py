@@ -94,9 +94,13 @@ def _orientation_maps(X):
 
 def _box_pool(maps, width: int):
     """Box-sum each orientation map over width×width windows ('flat window')
-    → (n, X-w+1, Y-w+1, 8)."""
+    → (n, X-w+1, Y-w+1, 8). Separable: two 1-D passes cost 2·W adds per
+    output instead of the 2-D window's W²."""
+    out = jax.lax.reduce_window(
+        maps, 0.0, jax.lax.add, (1, width, 1, 1), (1, 1, 1, 1), "valid"
+    )
     return jax.lax.reduce_window(
-        maps, 0.0, jax.lax.add, (1, width, width, 1), (1, 1, 1, 1), "valid"
+        out, 0.0, jax.lax.add, (1, 1, width, 1), (1, 1, 1, 1), "valid"
     )
 
 
@@ -124,6 +128,11 @@ def _sift_one_scale(gray, bin_size: int, step: int):
 
     # bin (i, j) of descriptor at (x, y) pools the box anchored at
     # (x + i·bin − (window−bin)//2, …) — centered flat window per bin.
+    # NOTE: these advanced-index gathers were once rewritten as edge-pad
+    # + stride-`step` slices (27% less HBM traffic by XLA's own count) —
+    # and ran 1.5× SLOWER: stride-3 slices on the second-minor dim defeat
+    # the TPU's vectorized loads worse than the gathers do. Measured,
+    # reverted; don't repeat.
     off = (window - bin_size) // 2
     px_max = pooled.shape[1] - 1
     py_max = pooled.shape[2] - 1
