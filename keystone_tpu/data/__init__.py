@@ -1,4 +1,5 @@
+from .chunked import ChunkedDataset
 from .dataset import Dataset
 from .sparse import SparseRows
 
-__all__ = ["Dataset", "SparseRows"]
+__all__ = ["ChunkedDataset", "Dataset", "SparseRows"]

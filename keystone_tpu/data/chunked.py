@@ -1,0 +1,319 @@
+"""Out-of-core datasets: a re-iterable row-chunk form of :class:`Dataset`.
+
+Parity: the reference's training sets are Spark RDDs — partitioned, lazily
+recomputed from lineage on every scan, cached only when they fit executor
+memory (``ImageNetSiftLcsFV.scala:98-135`` never materializes the featurized
+set; ``BlockWeightedLeastSquares.scala:177-313`` iterates per-partition Grams
+over it). :class:`ChunkedDataset` is the TPU-native analogue: the payload is a
+*factory* producing an iterator of batched row chunks, so
+
+  * transformer chains compose lazily per chunk (``map_batch`` returns a new
+    chunked dataset; nothing executes until a scan);
+  * every scan recomputes the chain from the source — lineage semantics —
+    unless :meth:`cache` finds the materialized form fits a byte budget;
+  * estimators that know how to stream (the block/weighted solvers, scalers)
+    accumulate per-chunk statistics instead of calling ``to_array()``, so a
+    featurized training set larger than HBM never materializes.
+
+Chunks carry a common leading batch dimension and may be arrays or tuples of
+arrays (the gather node zips branch chunks into tuples).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .dataset import Dataset, _rebatch
+
+
+def _payload_rows(payload: Any) -> int:
+    leaves = jax.tree_util.tree_leaves(payload)
+    return int(leaves[0].shape[0])
+
+
+def _payload_bytes(payload: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(payload):
+        total += int(np.prod(leaf.shape)) * int(
+            np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        )
+    return total
+
+
+def default_cache_budget_bytes() -> int:
+    """HBM budget under which :meth:`ChunkedDataset.cache` materializes.
+
+    Mirrors Spark's storage-fraction decision: a chunked set whose
+    materialized form fits comfortably is pinned; anything bigger keeps
+    recompute-on-scan semantics. Override with KEYSTONE_CHUNK_CACHE_BUDGET
+    (bytes)."""
+    return int(os.environ.get("KEYSTONE_CHUNK_CACHE_BUDGET", 2 << 30))
+
+
+def rechunk_batched(dataset: "Dataset", sizes: Sequence[int]) -> "ChunkedDataset":
+    """Chunked view of a materialized batched dataset at given boundaries —
+    used to align an in-memory gather branch with a chunked one."""
+    payload = dataset.payload
+    n = sum(sizes)
+
+    def factory():
+        i = 0
+        for sz in sizes:
+            lo = i
+            yield jax.tree_util.tree_map(lambda a: a[lo : lo + sz], payload)
+            i += sz
+
+    return ChunkedDataset(factory, n, label="rechunk")
+
+
+def align_and_zip(datasets: Sequence["Dataset"]) -> "ChunkedDataset":
+    """Zip mixed chunked/materialized branches into one chunked dataset of
+    tuples, WITHOUT a probing scan: the first chunked branch drives the
+    boundaries at iteration time; materialized branches are sliced by a
+    running row cursor and additional chunked branches are pulled in
+    lockstep (all chunked branches derive from one source, so their
+    boundaries agree by construction — checked per chunk)."""
+    chunked_idx = [
+        i for i, ds in enumerate(datasets) if isinstance(ds, ChunkedDataset)
+    ]
+    if not chunked_idx:
+        raise ValueError("align_and_zip needs at least one chunked branch")
+    n = len(datasets[0])
+    for ds in datasets[1:]:
+        if len(ds) != n:
+            raise ValueError("align_and_zip of datasets with different lengths")
+    lead = chunked_idx[0]
+    parents = {i: datasets[i]._payload for i in chunked_idx}
+    payloads = {
+        i: ds.payload
+        for i, ds in enumerate(datasets)
+        if i not in parents
+    }
+
+    def factory():
+        iters = {i: p() for i, p in parents.items()}
+        cursor = 0
+        for lead_chunk in iters[lead]:
+            rows = _payload_rows(lead_chunk)
+            out: List[Any] = []
+            for i in range(len(datasets)):
+                if i == lead:
+                    out.append(lead_chunk)
+                elif i in iters:
+                    c = next(iters[i], None)
+                    if c is None or _payload_rows(c) != rows:
+                        raise ValueError(
+                            "align_and_zip: misaligned chunk boundaries"
+                        )
+                    out.append(c)
+                else:
+                    lo = cursor
+                    out.append(
+                        jax.tree_util.tree_map(
+                            lambda a: a[lo : lo + rows], payloads[i]
+                        )
+                    )
+            cursor += rows
+            yield tuple(out)
+        if cursor != n:
+            raise ValueError(
+                f"align_and_zip: chunked branch produced {cursor} rows, expected {n}"
+            )
+        for i in chunked_idx[1:]:
+            if next(iters[i], None) is not None:
+                raise ValueError("align_and_zip: branch chunk counts differ")
+
+    return ChunkedDataset(factory, n, label="zip")
+
+
+class ChunkedDataset(Dataset):
+    """N rows produced in batched chunks by a re-iterable factory."""
+
+    def __init__(
+        self,
+        chunk_factory: Callable[[], Iterator[Any]],
+        num_rows: int,
+        *,
+        label: Optional[str] = None,
+    ):
+        # payload = the factory: DatasetOperator's payload-identity equality
+        # then keys on the factory object, which is what "same logical data"
+        # means for a lineage-recomputed collection.
+        super().__init__(chunk_factory, batched=True)
+        self._num_rows = int(num_rows)
+        self._label = label or "chunked"
+
+    # ---- constructors ---------------------------------------------------
+
+    @staticmethod
+    def from_array(arr: Any, chunk_rows: int) -> "ChunkedDataset":
+        """Chunked *view* of an in-memory array (testing + apply paths)."""
+        n = int(arr.shape[0])
+        if chunk_rows <= 0:
+            raise ValueError("chunk_rows must be positive")
+
+        def factory():
+            for i in range(0, n, chunk_rows):
+                yield arr[i : i + chunk_rows]
+
+        return ChunkedDataset(factory, n, label=f"array[{n}]")
+
+    @staticmethod
+    def from_chunk_fn(
+        chunk_fn: Callable[[int], Any],
+        num_chunks: int,
+        num_rows: int,
+        *,
+        label: Optional[str] = None,
+    ) -> "ChunkedDataset":
+        """Chunks generated by index — the deterministic-regeneration source
+        (synthetic benches, seeded loaders): ``chunk_fn(i)`` must return the
+        same payload for the same ``i`` on every scan."""
+
+        def factory():
+            for i in range(num_chunks):
+                yield chunk_fn(i)
+
+        return ChunkedDataset(factory, num_rows, label=label)
+
+    # ---- shape / access -------------------------------------------------
+
+    @property
+    def is_chunked(self) -> bool:
+        return True
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    def chunks(self) -> Iterator[Any]:
+        """One scan: recomputes the whole lazy chain chunk-by-chunk."""
+        return iter(self._payload())
+
+    def __iter__(self) -> Iterator[Any]:
+        for chunk in self.chunks():
+            rows = _payload_rows(chunk)
+            for i in range(rows):
+                yield jax.tree_util.tree_map(lambda a: a[i], chunk)
+
+    def first(self) -> Any:
+        chunk = next(self.chunks())
+        return jax.tree_util.tree_map(lambda a: a[0], chunk)
+
+    def to_array(self):
+        """Materialize by concatenating every chunk (small results only —
+        sampled descriptor sets, predictions; estimators stream instead)."""
+        import jax.numpy as jnp
+
+        parts = list(self.chunks())
+        if not parts:
+            raise ValueError("empty chunked dataset")
+        if len(parts) == 1:
+            return parts[0]
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *parts
+        )
+
+    # ---- functional ops (lazy) ------------------------------------------
+
+    def map_batch(self, fn: Callable[[Any], Any]) -> "ChunkedDataset":
+        """Lazily apply ``fn`` to every chunk — the transformer-chain hook.
+        The returned dataset recomputes ``fn`` per scan (lineage)."""
+        parent = self._payload
+
+        def factory():
+            for chunk in parent():
+                yield fn(chunk)
+
+        return ChunkedDataset(
+            factory, self._num_rows, label=f"{self._label}|map_batch"
+        )
+
+    def map(self, fn: Callable[[Any], Any]) -> "ChunkedDataset":
+        """Per-item fallback, applied within each chunk and restacked."""
+        parent = self._payload
+
+        import jax.numpy as jnp
+
+        def factory():
+            for chunk in parent():
+                rows = _payload_rows(chunk)
+                items = [
+                    jnp.asarray(
+                        fn(jax.tree_util.tree_map(lambda a: a[i], chunk))
+                    )
+                    for i in range(rows)
+                ]
+                yield _rebatch(items).payload
+
+        return ChunkedDataset(
+            factory, self._num_rows, label=f"{self._label}|map"
+        )
+
+    def cache(self, budget_bytes: Optional[int] = None) -> Dataset:
+        """Materialize iff the full set fits ``budget_bytes`` in HBM;
+        otherwise keep lineage-recompute semantics (returns self).
+
+        The size estimate computes ONE chunk (cost: one chunk of the chain);
+        a set that does materialize reuses that chunk's scan, so the decision
+        costs nothing extra in the fits-in-memory case."""
+        import jax.numpy as jnp
+
+        budget = default_cache_budget_bytes() if budget_bytes is None else budget_bytes
+        it = self.chunks()
+        try:
+            head = next(it)
+        except StopIteration:
+            raise ValueError("empty chunked dataset")
+        head_rows = _payload_rows(head)
+        est_total = _payload_bytes(head) * (self._num_rows / max(head_rows, 1))
+        if est_total > budget:
+            return self
+        parts: List[Any] = [head]
+        total = _payload_bytes(head)
+        for chunk in it:
+            total += _payload_bytes(chunk)
+            if total > budget:  # estimate was low (ragged chunks) — bail out
+                return self
+            parts.append(chunk)
+        payload = (
+            parts[0]
+            if len(parts) == 1
+            else jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *parts
+            )
+        )
+        return Dataset(payload, batched=True)
+
+    # ---- combination ----------------------------------------------------
+
+    @staticmethod
+    def zip_chunks(datasets: Sequence["ChunkedDataset"]) -> "ChunkedDataset":
+        """Zip N aligned chunked datasets into one whose chunks are tuples —
+        the gather node's chunked form. All inputs must share chunk
+        boundaries (true by construction when they derive from one source)."""
+        if not datasets:
+            raise ValueError("zip_chunks of zero datasets")
+        n = len(datasets[0])
+        for ds in datasets[1:]:
+            if len(ds) != n:
+                raise ValueError("zip_chunks of datasets with different lengths")
+        parents = [ds._payload for ds in datasets]
+
+        def factory():
+            iters = [p() for p in parents]
+            for chunks in zip(*iters):
+                rows = {_payload_rows(c) for c in chunks}
+                if len(rows) != 1:
+                    raise ValueError(
+                        f"zip_chunks: misaligned chunk boundaries {rows}"
+                    )
+                yield tuple(chunks)
+            for it in iters:  # all branches must be exhausted together
+                if next(it, None) is not None:
+                    raise ValueError("zip_chunks: branch chunk counts differ")
+
+        return ChunkedDataset(factory, n, label="zip")

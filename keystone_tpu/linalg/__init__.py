@@ -9,7 +9,12 @@ from .normal_equations import (
     solve_least_squares_streaming,
     solve_least_squares_with_intercept,
 )
-from .bcd import solve_blockwise_l2, solve_blockwise_l2_scan
+from .bcd import (
+    solve_blockwise_l2,
+    solve_blockwise_l2_scan,
+    solve_blockwise_l2_streaming,
+    stream_column_means,
+)
 from .tsqr import tsqr_r
 
 __all__ = [
@@ -23,5 +28,7 @@ __all__ = [
     "solve_least_squares_with_intercept",
     "solve_blockwise_l2",
     "solve_blockwise_l2_scan",
+    "solve_blockwise_l2_streaming",
+    "stream_column_means",
     "tsqr_r",
 ]

@@ -223,13 +223,32 @@ class ColumnSampler(Transformer):
         return x[:, jnp.asarray(cols)]
 
     def apply_batch(self, data):
+        from ...data.chunked import ChunkedDataset
+
         data = Dataset.of(data)
         if not data.is_batched:
             return data.map(self.apply)
-        X = data.to_array()  # (n, d, m), device-resident
+        if isinstance(data, ChunkedDataset):
+            # per-chunk device gather, lazily — the sampled set is small and
+            # materializes at the consumer; the descriptor stack never does.
+            # Column draws key on (seed, chunk index), NOT the stateful rng:
+            # a lazy chunked chain re-runs on every scan, and the lineage
+            # contract requires identical chunks each time.
+            parent = data.chunks
+            seed = self.seed
+
+            def factory():
+                for i, chunk in enumerate(parent()):
+                    rng = np.random.default_rng((seed, i))
+                    yield self._sample_batch(chunk, rng)
+
+            return ChunkedDataset(factory, len(data), label="col_sample")
+        return Dataset(self._sample_batch(data.to_array()), batched=True)
+
+    def _sample_batch(self, X, rng=None):
+        rng = self._rng if rng is None else rng
         n, _, m = X.shape
-        cols = self._rng.integers(0, m, size=(n, self.num_samples))
-        out = jnp.take_along_axis(
+        cols = rng.integers(0, m, size=(n, self.num_samples))
+        return jnp.take_along_axis(
             X, jnp.asarray(cols)[:, None, :], axis=2
         )
-        return Dataset(out, batched=True)

@@ -79,7 +79,12 @@ class VectorCombiner(Transformer):
         return jnp.concatenate([jnp.asarray(x) for x in xs], axis=-1)
 
     def apply_batch(self, data: Dataset) -> Dataset:
+        from ...data.chunked import ChunkedDataset
+
         data = Dataset.of(data)
+        if isinstance(data, ChunkedDataset):
+            # zipped gather chunks are tuples — concat lazily per chunk
+            return data.map_batch(self.trace_batch)
         if data.is_batched and isinstance(data.payload, (list, tuple)):
             # gather output: a tuple of (n, d_i) arrays — concat on device.
             return Dataset(

@@ -127,7 +127,17 @@ class FusedTransformerOperator(TransformerOperator):
     # -- operator glue --------------------------------------------------
 
     def batch_transform(self, inputs: Sequence[DatasetExpression]) -> Dataset:
+        from ..data.chunked import ChunkedDataset, align_and_zip
+
         datasets = [d.get() for d in inputs]
+        if any(isinstance(ds, ChunkedDataset) for ds in datasets):
+            # out-of-core inputs: the fused program runs chunk-by-chunk,
+            # lazily — one compiled executable per chunk shape, intermediates
+            # bounded by one chunk (the whole point of data/chunked.py)
+            if len(datasets) == 1:
+                return datasets[0].map_batch(lambda x: self._jitted()(x))
+            zipped = align_and_zip(datasets)
+            return zipped.map_batch(lambda t: self._jitted()(*t))
         if all(ds.is_batched for ds in datasets):
             arrays = [ds.to_array() for ds in datasets]
             return Dataset(self._jitted()(*arrays), batched=True)

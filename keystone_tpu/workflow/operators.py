@@ -220,7 +220,13 @@ class GatherTransformerOperator(TransformerOperator):
         return [d.get() for d in inputs]
 
     def batch_transform(self, inputs: Sequence[DatasetExpression]) -> Dataset:
+        from ..data.chunked import ChunkedDataset, align_and_zip
+
         datasets = [d.get() for d in inputs]
+        if any(isinstance(ds, ChunkedDataset) for ds in datasets):
+            # chunked branches zip per-chunk and stay lazy; materialized
+            # branches are sliced at the chunked boundaries as the scan runs
+            return align_and_zip(datasets)
         if all(ds.is_batched for ds in datasets):
             # keep branches as a tuple-of-arrays batched payload
             return Dataset(tuple(ds.payload for ds in datasets), batched=True)

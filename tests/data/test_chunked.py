@@ -1,0 +1,112 @@
+"""ChunkedDataset: lazy per-chunk composition, lineage recompute, cache
+budget policy, and zip alignment (the RDD analogue, data/chunked.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import ChunkedDataset, Dataset
+
+
+def _src(n=37, d=5, chunk=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    return X, ChunkedDataset.from_array(X, chunk)
+
+
+def test_len_iter_first_to_array():
+    X, ds = _src()
+    assert len(ds) == 37
+    assert ds.is_batched and ds.is_chunked
+    np.testing.assert_allclose(np.asarray(ds.to_array()), X)
+    np.testing.assert_allclose(np.asarray(ds.first()), X[0])
+    items = list(ds)
+    assert len(items) == 37
+    np.testing.assert_allclose(np.asarray(items[11]), X[11])
+
+
+def test_map_batch_is_lazy_and_recomputes_per_scan():
+    X, ds = _src()
+    calls = []
+
+    def fn(chunk):
+        calls.append(1)
+        return chunk * 2.0
+
+    mapped = ds.map_batch(fn)
+    assert not calls  # nothing ran yet
+    np.testing.assert_allclose(np.asarray(mapped.to_array()), X * 2)
+    first_scan = len(calls)
+    assert first_scan == 5  # ceil(37/8)
+    mapped.to_array()
+    assert len(calls) == 2 * first_scan  # lineage: recompute per scan
+
+
+def test_map_per_item_matches_dataset_map():
+    X, ds = _src()
+    out = ds.map(lambda row: row.sum())
+    np.testing.assert_allclose(
+        np.asarray(out.to_array()), X.sum(axis=1), rtol=1e-6
+    )
+
+
+def test_cache_materializes_under_budget_only():
+    X, ds = _src()
+    cached = ds.cache(budget_bytes=1 << 20)
+    assert not isinstance(cached, ChunkedDataset)
+    np.testing.assert_allclose(np.asarray(cached.to_array()), X)
+    still = ds.cache(budget_bytes=16)
+    assert isinstance(still, ChunkedDataset)
+
+
+def test_zip_chunks_aligned_and_misaligned():
+    X, a = _src(seed=1)
+    Y, b = _src(seed=2)
+    zipped = ChunkedDataset.zip_chunks([a, b])
+    chunks = list(zipped.chunks())
+    assert all(isinstance(c, tuple) and len(c) == 2 for c in chunks)
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate([c[1] for c in chunks])), Y
+    )
+    bad = ChunkedDataset.from_array(Y, 7)
+    with pytest.raises(ValueError):
+        list(ChunkedDataset.zip_chunks([a, bad]).chunks())
+
+
+def test_transformer_chain_composes_per_chunk():
+    from keystone_tpu.workflow.transformer import FunctionNode
+
+    X, ds = _src()
+    node = FunctionNode(batch_fn=lambda x: x + 1.0)
+    out = node.apply_batch(ds)
+    assert isinstance(out, ChunkedDataset)
+    np.testing.assert_allclose(np.asarray(out.to_array()), X + 1)
+
+
+def test_gather_and_vector_combiner_zip_chunked_branches():
+    from keystone_tpu.nodes.util import VectorCombiner
+    from keystone_tpu.workflow.pipeline import Pipeline
+    from keystone_tpu.workflow.transformer import FunctionNode
+
+    X, ds = _src()
+    b1 = FunctionNode(batch_fn=lambda x: x * 2.0)
+    b2 = FunctionNode(batch_fn=lambda x: x - 1.0)
+    pipe = Pipeline.gather([b1, b2]).and_then(VectorCombiner())
+    out = pipe.apply(ds).get()
+    assert isinstance(out, ChunkedDataset)
+    np.testing.assert_allclose(
+        np.asarray(out.to_array()),
+        np.concatenate([X * 2, X - 1], axis=-1),
+        rtol=1e-6,
+    )
+
+
+def test_from_chunk_fn_deterministic_regeneration():
+    def chunk_fn(i):
+        rng = np.random.default_rng(100 + i)
+        return rng.standard_normal((4, 3)).astype(np.float32)
+
+    ds = ChunkedDataset.from_chunk_fn(chunk_fn, num_chunks=3, num_rows=12)
+    a = np.asarray(ds.to_array())
+    b = np.asarray(ds.to_array())
+    np.testing.assert_array_equal(a, b)
