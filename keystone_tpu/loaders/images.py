@@ -51,6 +51,11 @@ def decode_image_bytes(
 ) -> Optional[np.ndarray]:
     """JPEG/PNG bytes → (x, y, c) array in [0,255], or None.
 
+    .. warning:: BEHAVIOR CHANGE (round 4): the default return dtype is
+       ``uint8``, not ``float32``. Host-side float arithmetic on the
+       result (mean subtraction, scaling) silently wraps around at 8 bits
+       — pass ``dtype=np.float32`` explicitly if you compute on the host.
+
     uint8 by default — a TPU-first ingestion decision, not an accident:
     decoded pixels ARE bytes, and keeping them so until the device means
     4× less host RAM and 4× less host→device transfer than the

@@ -222,7 +222,14 @@ def _streamed_gram(X, B):
     and is dropped before the next, so peak memory is G + one block."""
     d = X.shape[1]
     n, m = X.indices.shape
-    row_chunk = max(1, (1 << 21) // max(m, 1))
+    # chunk rows by BOTH the scatter-update count (padding bound) and the
+    # densified block's bytes (rows·d·4 — at d=16384 an update-count-only
+    # bound allowed ~1.6 GB blocks, breaking the "G + one block" claim)
+    block_budget_bytes = 256 << 20
+    row_chunk = max(
+        1,
+        min((1 << 21) // max(m, 1), block_budget_bytes // max(4 * d, 1)),
+    )
     G = jnp.zeros((d, d), dtype=jnp.float32)
     c = jnp.zeros((d,) + B.shape[1:], dtype=jnp.float32)
     for i in range(0, n, row_chunk):

@@ -246,6 +246,20 @@ class PackedStupidBackoffModel(Transformer):
 
     def score_packed(self, q: np.ndarray) -> np.ndarray:
         q = np.asarray(q, dtype=np.int64).copy()
+        # Keys holding the -1 OOV sentinel (pack_batch deliberately skips
+        # validation) sign-extend to control bits 0xF; order_batch would
+        # read order 16 and remove_farthest_word_batch would then alias a
+        # REAL bigram key — a wrong score or a spurious "count table
+        # inconsistent" error, not a miss. Reject them here; the dict-form
+        # model handles such queries via legitimate backoff.
+        bad = (q < 0) | (((q >> 60) & 0xF) > 2)
+        if bad.any():
+            raise ValueError(
+                "score_packed: invalid packed key(s) (negative word id / "
+                "corrupt control bits — e.g. a -1 OOV sentinel packed by "
+                "pack_batch); score such queries via the dict-form model "
+                "or filter OOV ids before packing"
+            )
         n = len(q)
         accum = np.ones(n, dtype=np.float64)
         score = np.zeros(n, dtype=np.float64)

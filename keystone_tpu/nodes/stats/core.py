@@ -230,20 +230,26 @@ class ColumnSampler(Transformer):
             return data.map(self.apply)
         if isinstance(data, ChunkedDataset):
             # per-chunk device gather, lazily — the sampled set is small and
-            # materializes at the consumer; the descriptor stack never does.
-            # Column draws key on (seed, chunk index), NOT the stateful rng:
-            # a lazy chunked chain re-runs on every scan, and the lineage
-            # contract requires identical chunks each time.
+            # materializes at the consumer; the descriptor stack never does
             parent = data.chunks
-            seed = self.seed
 
             def factory():
                 for i, chunk in enumerate(parent()):
-                    rng = np.random.default_rng((seed, i))
-                    yield self._sample_batch(chunk, rng)
+                    yield self.sample_chunk(chunk, i)
 
             return ChunkedDataset(factory, len(data), label="col_sample")
         return Dataset(self._sample_batch(data.to_array()), batched=True)
+
+    def sample_chunk(self, X, chunk_index: int):
+        """Sample one chunk of a chunked scan. Column draws key on
+        (seed, chunk index), NOT the stateful rng: a lazy chunked chain
+        re-runs on every scan, and the lineage contract requires identical
+        chunks each time. Shared by the chunked ``apply_batch`` path and
+        callers that drive one combined scan themselves (the ImageNet FV
+        branch builder draws PCA + GMM samples in a single featurize pass)."""
+        return self._sample_batch(
+            X, np.random.default_rng((self.seed, chunk_index))
+        )
 
     def _sample_batch(self, X, rng=None):
         rng = self._rng if rng is None else rng

@@ -104,8 +104,58 @@ def compute_pca_fisher_branch(
     seed: int = 0,
 ) -> Pipeline:
     """PCA + FV tail over a descriptor-extracting prefix
-    (parity: computePCAandFisherBranch, ImageNetSiftLcsFV.scala:22-74)."""
+    (parity: computePCAandFisherBranch, ImageNetSiftLcsFV.scala:22-74).
+
+    The reference derives BOTH samplers from numPcaSamples and leaves
+    numGmmSamples unused (ImageNetSiftLcsFV.scala:108,146-167); here the GMM
+    sample budget is honored when given. TPU-first reorder: the reference
+    samples AFTER projecting the full descriptor set
+    (sampler(pcaFeaturizer(data))); the PCA projection is per-column, so
+    sampling first is distributionally identical and skips ~15× of
+    projection work (only sampled columns project). Out-of-core inputs
+    (``ChunkedDataset``) draw both samples in ONE chunk-by-chunk featurize
+    scan — the descriptor stacks for the full training set never coexist in
+    device memory (parity: ImageNetSiftLcsFV.scala:98-135 never collects
+    the descriptor RDD)."""
+    from ..data.chunked import ChunkedDataset
     from ..utils.timing import phase
+
+    need_pca_sample = not pca_file
+    need_gmm_sample = not gmm_mean_file
+    pca_sample = desc_sample = None
+    if need_pca_sample or need_gmm_sample:
+        gmm_per_img = gmm_samples_per_image or num_col_samples_per_image
+        with phase("imagenet.descriptors+samples") as out:
+            prefix_out = prefix(train_images).get()
+            if isinstance(prefix_out, ChunkedDataset):
+                # both samplers share ONE featurize scan, each drawing via
+                # its (seed, chunk-index)-keyed sample_chunk contract
+                s_pca = ColumnSampler(num_col_samples_per_image, seed=seed)
+                s_gmm = ColumnSampler(gmm_per_img, seed=seed + 1)
+                pca_parts, gmm_parts = [], []
+                for i, chunk in enumerate(prefix_out.chunks()):
+                    if need_pca_sample:
+                        pca_parts.append(s_pca.sample_chunk(chunk, i))
+                    if need_gmm_sample:
+                        gmm_parts.append(s_gmm.sample_chunk(chunk, i))
+                if need_pca_sample:
+                    pca_sample = Dataset(
+                        jnp.concatenate(pca_parts, axis=0), batched=True
+                    )
+                if need_gmm_sample:
+                    desc_sample = Dataset(
+                        jnp.concatenate(gmm_parts, axis=0), batched=True
+                    )
+            else:
+                if need_pca_sample:
+                    pca_sample = ColumnSampler(
+                        num_col_samples_per_image, seed=seed
+                    ).apply_batch(prefix_out)
+                if need_gmm_sample:
+                    desc_sample = ColumnSampler(
+                        gmm_per_img, seed=seed + 1
+                    ).apply_batch(prefix_out)
+            out.append((pca_sample or desc_sample).to_array())
 
     if pca_file:
         pca_mat = np.loadtxt(pca_file, delimiter=",", ndmin=2).T
@@ -118,10 +168,6 @@ def compute_pca_fisher_branch(
         ).to_pipeline()
         pca_featurizer = prefix.and_then(pca_apply)
     else:
-        sampler = ColumnSampler(num_col_samples_per_image, seed=seed).to_pipeline()
-        with phase("imagenet.descriptors+pca_sample") as out:
-            pca_sample = sampler(prefix(train_images).get()).get()
-            out.append(pca_sample.to_array())
         pca_apply = ColumnPCAEstimator(desc_dim).with_data(pca_sample)
         pca_featurizer = prefix.and_then(pca_apply)
 
@@ -131,19 +177,7 @@ def compute_pca_fisher_branch(
         # a loaded codebook sets this branch's FV width (see voc_sift_fisher)
         vocab_size = int(gmm.k)
     else:
-        # The reference derives BOTH samplers from numPcaSamples and leaves
-        # numGmmSamples unused (ImageNetSiftLcsFV.scala:108,146-167); here
-        # the GMM sample budget is honored when given. TPU-first reorder:
-        # the reference samples AFTER projecting the full descriptor set
-        # (sampler(pcaFeaturizer(data))); the PCA projection is per-column,
-        # so sampling first is distributionally identical and skips ~15× of
-        # projection work (only sampled columns project). The cached prefix
-        # output is reused from the PCA phase.
-        sampler = ColumnSampler(
-            gmm_samples_per_image or num_col_samples_per_image, seed=seed + 1
-        ).to_pipeline()
-        with phase("imagenet.pca_fit+gmm_sample") as out:
-            desc_sample = sampler(prefix(train_images).get()).get()
+        with phase("imagenet.pca_fit+gmm_project") as out:
             gmm_sample = pca_apply(desc_sample).get()
             out.append(gmm_sample.to_array())
         fv = GMMFisherVectorEstimator(

@@ -66,6 +66,12 @@ class FusedTransformerOperator(TransformerOperator):
         inner = " » ".join(op.label for op, _ in self.steps)
         return f"Fused[{inner}]"
 
+    @property
+    def batch_coupled(self) -> bool:
+        return any(
+            getattr(op, "batch_coupled", False) for op, _ in self.steps
+        )
+
     # -- traced path ----------------------------------------------------
 
     def trace_batch(self, *xs):
@@ -134,6 +140,17 @@ class FusedTransformerOperator(TransformerOperator):
             # out-of-core inputs: the fused program runs chunk-by-chunk,
             # lazily — one compiled executable per chunk shape, intermediates
             # bounded by one chunk (the whole point of data/chunked.py)
+            if self.batch_coupled:
+                coupled = [
+                    op.label
+                    for op, _ in self.steps
+                    if getattr(op, "batch_coupled", False)
+                ]
+                raise ValueError(
+                    f"batch-coupled node(s) {coupled} cannot stream "
+                    "per-chunk: batch statistics would be computed per "
+                    "chunk — materialize the dataset first"
+                )
             if len(datasets) == 1:
                 return datasets[0].map_batch(lambda x: self._jitted()(x))
             zipped = align_and_zip(datasets)

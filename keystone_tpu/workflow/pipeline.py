@@ -428,11 +428,21 @@ class FittedPipeline(Chainable):
         Valid ONLY for row-wise chains — each output row a function of
         its input row alone — which holds for every serve-path
         transformer in this library's pipelines (fitted normalizers,
-        featurizers, linear models, classifiers). Batch-coupled nodes
-        must go through :meth:`apply`.
+        featurizers, linear models, classifiers). Nodes declaring
+        ``batch_coupled = True`` are rejected here (the padded tail
+        chunk would silently change their output) and must go through
+        :meth:`apply`.
         """
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
+        for node in self._graph.nodes:
+            op = self._graph.get_operator(node)
+            if getattr(op, "batch_coupled", False):
+                raise ValueError(
+                    f"apply_chunked on a batch-coupled chain ({op.label}): "
+                    "the padded tail chunk would corrupt batch statistics — "
+                    "use apply() instead"
+                )
         if self._compiled is None:
             self.compile()
         arr = Dataset.of(data).to_array() if not hasattr(data, "shape") else data

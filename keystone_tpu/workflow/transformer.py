@@ -48,6 +48,12 @@ class Transformer(Chainable, TransformerOperator):
     #: override in subclasses whose trace_batch is pure jax
     trace_batch: Optional[Callable] = None
 
+    #: set True on transformers whose trace_batch couples rows (batch
+    #: statistics, whole-batch normalization, ...). ``apply_chunked``
+    #: refuses such chains — its pad-and-slice tail would silently change
+    #: their output — and routes callers to ``apply`` instead.
+    batch_coupled: bool = False
+
     def apply(self, x: Any) -> Any:
         if self.trace_batch is not None:
             import jax.numpy as jnp
@@ -61,6 +67,13 @@ class Transformer(Chainable, TransformerOperator):
         # eager on TPU). Whole-chain fusion happens at the pipeline level
         # (FittedPipeline.compile), where one program covers every node.
         data = Dataset.of(data)
+        if self.batch_coupled and getattr(data, "is_chunked", False):
+            raise ValueError(
+                f"{type(self).__name__} is batch-coupled: running it "
+                "per-chunk would compute batch statistics per chunk, "
+                "silently diverging from whole-batch output — "
+                "materialize the dataset (e.g. .cache()) first"
+            )
         if self.trace_batch is not None and data.is_batched:
             return data.map_batch(self.trace_batch)
         return data.map(self.apply)

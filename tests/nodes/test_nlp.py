@@ -478,3 +478,28 @@ def test_logistic_regression_sparse_learns():
         model.apply_batch(Dataset(sr, batched=True)).to_array()
     )
     assert (pred == y).mean() > 0.95
+
+
+def test_packed_stupid_backoff_rejects_oov_sentinel_keys():
+    """score_packed must REFUSE keys carrying the -1 OOV sentinel
+    (ADVICE r4 medium): pack_batch skips validation, the sentinel
+    sign-extends to control bits 0xF, and the backoff arithmetic then
+    aliases a REAL bigram key — a silently wrong score, not a miss. The
+    dict-form model scores the same query correctly via backoff."""
+    import numpy as np
+    import pytest as _pytest
+
+    from keystone_tpu.nodes.nlp.indexers import NaiveBitPackIndexer
+    from keystone_tpu.nodes.nlp.stupid_backoff import (
+        PackedStupidBackoffModel,
+        StupidBackoffModel,
+    )
+
+    lm = StupidBackoffModel({}, {(5, 7): 4, (7,): 2}, {5: 3, 7: 6}, 11)
+    packed = PackedStupidBackoffModel.from_model(lm)
+    bad = NaiveBitPackIndexer.pack_batch(np.asarray([[-1, 5, 7]]), 3)
+    with _pytest.raises(ValueError, match="OOV"):
+        packed.score_packed(bad)
+    # valid keys still score
+    ok = np.asarray([NaiveBitPackIndexer.pack((5, 7))])
+    assert packed.score_packed(ok)[0] > 0

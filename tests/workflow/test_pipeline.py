@@ -222,3 +222,25 @@ def test_apply_chunked_empty_input_matches_apply():
     got = np.asarray(fitted.apply_chunked(empty, chunk_size=4).to_array())
     want = np.asarray(fitted.apply(empty).to_array())
     assert got.shape == want.shape == (0, 2)
+
+
+def test_apply_chunked_rejects_batch_coupled_chain():
+    """A transformer declaring batch_coupled=True (its output depends on
+    batch statistics) must be refused by apply_chunked — the padded tail
+    chunk would silently corrupt those statistics (ADVICE r4)."""
+    import pytest
+
+    class BatchZScore(Transformer):
+        batch_coupled = True
+
+        def trace_batch(self, X):
+            return (X - X.mean(axis=0)) / (X.std(axis=0) + 1e-6)
+
+    fitted = (Doubler() >> BatchZScore()).to_pipeline().fit()
+    X = jnp.asarray(np.random.default_rng(0).standard_normal((5, 2)),
+                    dtype=jnp.float32)
+    with pytest.raises(ValueError, match="batch-coupled"):
+        fitted.apply_chunked(X, chunk_size=4)
+    # apply() still serves it
+    out = np.asarray(fitted.apply(X).to_array())
+    assert out.shape == (5, 2)
