@@ -141,10 +141,69 @@ def solve_blockwise_l2_scan(
     d = A.shape[1]
     if d % block_size != 0:
         raise ValueError(f"d={d} not divisible by block_size={block_size}")
-    if means is None:
-        return _bcd_scan(A, y, jnp.asarray(reg, dtype), None, block_size, num_iter)
-    means = jnp.asarray(means, dtype=dtype).reshape(d)
+    if means is not None:
+        means = jnp.asarray(means, dtype=dtype).reshape(d)
+    fn = _bcd_scan_model_sharded(
+        A.shape[0], d, block_size, num_iter, means is not None
+    )
+    if fn is not None:
+        return fn(A, y, jnp.asarray(reg, dtype), means)
     return _bcd_scan(A, y, jnp.asarray(reg, dtype), means, block_size, num_iter)
+
+
+def _bcd_scan_model_sharded(n, d, block_size, num_iter, has_means):
+    """A model-axis-distributed compile of :func:`_bcd_scan`, or None.
+
+    The reference distributes the d dimension across the cluster
+    (VectorSplitter + BlockLinearMapper.scala:199-257: each feature block's
+    rows live cluster-wide and the driver walks blocks). Mesh-native form:
+    A's columns, the column means, and the output W shard over MODEL_AXIS
+    (P(data, model) / P(model) / P(model, None) respectively), so a d too
+    large for one device's HBM (d=65k: W + per-block Grams) memory-scales
+    across the model axis while the Gram/cross psums still ride the data
+    axis. The block loop stays sequential — same as the reference, where
+    BCD is inherently block-serial; the model axis buys MEMORY, not
+    parallel block solves. Requires each model shard to hold whole blocks
+    (d/n_model divisible by block_size); returns None (unsharded compile)
+    otherwise or on a 1-wide model axis."""
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
+
+    mesh = default_mesh()
+    n_model = mesh.shape.get(MODEL_AXIS, 1)
+    if n_model <= 1 or d % n_model != 0 or (d // n_model) % block_size != 0:
+        return None
+    if n % mesh.shape.get(DATA_AXIS, 1) != 0:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key = (mesh, d, block_size, num_iter, has_means)
+    entry = _bcd_sharded_cache.get(key)
+    if entry is None:
+        a_s = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+        y_s = NamedSharding(mesh, P(DATA_AXIS))
+        m_s = NamedSharding(mesh, P(MODEL_AXIS)) if has_means else None
+        w_s = NamedSharding(mesh, P(MODEL_AXIS))
+        rep = NamedSharding(mesh, P())
+
+        def fn(A, y, reg, means):
+            return _bcd_scan_impl(A, y, reg, means, block_size, num_iter)
+
+        jitted = jax.jit(
+            fn, in_shardings=(a_s, y_s, rep, m_s), out_shardings=w_s
+        )
+
+        def call(A, y, reg, means):
+            # inputs may arrive committed to other layouts (the estimator's
+            # row-only shard_batch) — re-place to the 2-D sharding first
+            A = jax.device_put(A, a_s)
+            y = jax.device_put(y, y_s)
+            if has_means:
+                means = jax.device_put(means, m_s)
+            return jitted(A, y, jax.device_put(reg, rep), means)
+
+        call.lower = jitted.lower  # for HLO inspection in tests
+        entry = _bcd_sharded_cache[key] = call
+    return entry
 
 
 def _stream_chunk_update_impl(
@@ -312,8 +371,7 @@ def stream_column_means(chunk_scan, dtype=jnp.float32):
     return sums / n, n
 
 
-@partial(jax.jit, static_argnames=("block_size", "num_iter"))
-def _bcd_scan(A, y, reg, means, block_size, num_iter):
+def _bcd_scan_impl(A, y, reg, means, block_size, num_iter):
     n, d = A.shape
     nblocks = d // block_size
     k = y.shape[1]
@@ -343,3 +401,10 @@ def _bcd_scan(A, y, reg, means, block_size, num_iter):
 
     (W, pred), _ = jax.lax.scan(epoch, (W0, pred0), None, length=num_iter)
     return W.reshape(d, k)
+
+
+_bcd_scan = jax.jit(_bcd_scan_impl, static_argnames=("block_size", "num_iter"))
+
+#: jitted model-sharded _bcd_scan compiles, keyed by (mesh, shape, config) —
+#: a fresh jax.jit wrapper per call would retrace every fit
+_bcd_sharded_cache: dict = {}

@@ -114,6 +114,75 @@ def test_replicated_inputs_compile_without_collectives(data_mesh):
     assert "all-reduce" not in compiled.as_text()
 
 
+def test_block_solver_model_axis_sharding():
+    """VERDICT r4 #6: the MAIN block solver's d dimension distributes over
+    MODEL_AXIS — W comes out P(model)-sharded (each device owns a column
+    slice of the model), the data-axis Gram reduction is still a
+    collective, and the result agrees with the unsharded solve."""
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.linalg import solve_blockwise_l2_scan
+    from keystone_tpu.parallel.mesh import MODEL_AXIS
+
+    n, d, k, bs = 64, 16, 4, 4
+    rng = np.random.default_rng(5)
+    An = rng.standard_normal((n, d)).astype(np.float32)
+    yn = rng.standard_normal((n, k)).astype(np.float32)
+    means = An.mean(axis=0)
+
+    W_rep = np.asarray(
+        solve_blockwise_l2_scan(
+            jnp.asarray(An), jnp.asarray(yn), reg=1.0, block_size=bs,
+            num_iter=1, means=jnp.asarray(means),
+        )
+    )
+    mesh = make_mesh(n_data=4, n_model=2)
+    with use_mesh(mesh):
+        W = solve_blockwise_l2_scan(
+            jnp.asarray(An), jnp.asarray(yn), reg=1.0, block_size=bs,
+            num_iter=1, means=jnp.asarray(means),
+        )
+        assert W.sharding.spec == P(MODEL_AXIS), W.sharding
+        # per-device shard really is a 1/n_model column slice of the model
+        shard_shapes = {s.data.shape for s in W.addressable_shards}
+        assert shard_shapes == {(d // 2, k)}, shard_shapes
+
+        from keystone_tpu.linalg.bcd import _bcd_scan_model_sharded
+
+        jitted = _bcd_scan_model_sharded(n, d, bs, 1, True)
+        txt = jitted.lower(
+            jnp.asarray(An), jnp.asarray(yn), jnp.float32(1.0),
+            jnp.asarray(means),
+        ).compile().as_text()
+        assert "all-reduce" in txt, "no cross-device Gram reduction"
+    np.testing.assert_allclose(np.asarray(W), W_rep, rtol=2e-4, atol=2e-5)
+
+
+def test_block_estimator_uses_model_axis_on_mixed_mesh():
+    """BlockLeastSquaresEstimator.fit on a data×model mesh produces the
+    same model as on a pure data mesh (the sharded compile is routed
+    through transparently)."""
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+
+    n, d, k = 64, 16, 4
+    rng = np.random.default_rng(6)
+    An = rng.standard_normal((n, d)).astype(np.float32)
+    yn = rng.standard_normal((n, k)).astype(np.float32)
+    est = BlockLeastSquaresEstimator(block_size=4, num_iter=1, lam=0.5)
+    m_data = est.fit(Dataset.of(jnp.asarray(An)), Dataset.of(jnp.asarray(yn)))
+    with use_mesh(make_mesh(n_data=4, n_model=2)):
+        m_mixed = est.fit(
+            Dataset.of(jnp.asarray(An)), Dataset.of(jnp.asarray(yn))
+        )
+    Xt = rng.standard_normal((7, d)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(m_mixed.trace_batch(jnp.asarray(Xt))),
+        np.asarray(m_data.trace_batch(jnp.asarray(Xt))),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
 def test_sharded_and_replicated_results_agree(data_mesh):
     n, d, k, bs = 64, 16, 4, 8
     rng = np.random.default_rng(4)
