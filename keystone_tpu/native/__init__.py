@@ -66,6 +66,21 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p,
     ]
     lib.ks_ngram_hash_features_batch.restype = ctypes.c_int64
+    lib.ks_text_frontend.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.ks_text_frontend.restype = ctypes.c_int64
+    lib.ks_packed_grams_unique.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.ks_packed_grams_unique.restype = ctypes.c_int64
     return lib
 
 
@@ -118,6 +133,110 @@ def java_string_hash_batch(tokens: Sequence[str]) -> Optional[np.ndarray]:
         _ptr(cps), _ptr(offsets), len(tokens), _ptr(out)
     )
     return out
+
+
+def text_frontend_batch(
+    docs: Sequence[str],
+    vocab_tokens: Sequence[str],
+    grow: bool,
+    trim: bool = True,
+    lower: bool = True,
+):
+    """Fused trim→lowercase→tokenize→token-id pass over a raw-string corpus
+    (spec: Trim/LowerCase/Tokenizer in nodes/nlp/text.py followed by
+    packed_features._token_ids). Returns ``(ids int64, tok_doc_offsets
+    int64, new_tokens list[str])`` — per-doc id slices delimited by the
+    offsets, new vocabulary entries in first-seen order starting at
+    ``len(vocab_tokens)`` — or None when native is unavailable or the
+    corpus/vocab is not pure ASCII (the Python path's unicode ``\\w``
+    semantics then apply)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        blob = "".join(docs).encode("ascii")
+        vblob = "".join(vocab_tokens).encode("ascii")
+    except UnicodeEncodeError:
+        return None
+    n_docs = len(docs)
+    doc_off = np.zeros(n_docs + 1, dtype=np.int64)
+    np.cumsum(
+        np.fromiter((len(d) for d in docs), dtype=np.int64, count=n_docs),
+        out=doc_off[1:],
+    )
+    v_off = np.zeros(len(vocab_tokens) + 1, dtype=np.int64)
+    np.cumsum(
+        np.fromiter(
+            (len(t) for t in vocab_tokens), dtype=np.int64,
+            count=len(vocab_tokens),
+        ),
+        out=v_off[1:],
+    )
+    text_len = int(doc_off[-1])
+    cap = text_len + n_docs + 1
+    ids = np.empty(cap, dtype=np.int64)
+    tok_off = np.zeros(n_docs + 1, dtype=np.int64)
+    new_bytes = np.empty(max(text_len, 1), dtype=np.uint8)
+    new_off = np.zeros(cap, dtype=np.int64)
+    new_count = np.zeros(1, dtype=np.int64)
+    tbuf = np.frombuffer(blob, dtype=np.uint8) if blob else np.zeros(1, np.uint8)
+    vbuf = np.frombuffer(vblob, dtype=np.uint8) if vblob else np.zeros(1, np.uint8)
+    ntok = lib.ks_text_frontend(
+        _ptr(tbuf), _ptr(doc_off), n_docs,
+        int(trim), int(lower),
+        _ptr(vbuf), _ptr(v_off), len(vocab_tokens),
+        int(grow),
+        _ptr(ids), _ptr(tok_off),
+        _ptr(new_bytes), _ptr(new_off), _ptr(new_count),
+    )
+    if ntok < 0:  # pragma: no cover - defensive
+        return None
+    nc = int(new_count[0])
+    nb = new_bytes[: int(new_off[nc])].tobytes().decode("ascii")
+    new_tokens = [
+        nb[int(new_off[i]) : int(new_off[i + 1])] for i in range(nc)
+    ]
+    return ids[:ntok], tok_off, new_tokens
+
+
+def packed_grams_unique(
+    ids_list: Sequence[np.ndarray], orders: Sequence[int]
+):
+    """Per-(doc, gram) unique counts over packed n-grams — the native form
+    of packed_features._corpus_grams + _per_doc_unique (doc-local sorts
+    instead of a corpus lexsort). Returns ``(d_u, g_u, counts)`` in the
+    same doc-major / first-emission order, or None if native is
+    unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_docs = len(ids_list)
+    lens = np.fromiter(
+        (len(a) for a in ids_list), dtype=np.int64, count=n_docs
+    )
+    tok_off = np.zeros(n_docs + 1, dtype=np.int64)
+    np.cumsum(lens, out=tok_off[1:])
+    flat = (
+        np.ascontiguousarray(np.concatenate(ids_list), dtype=np.int64)
+        if int(tok_off[-1])
+        else np.zeros(1, dtype=np.int64)
+    )
+    orders_arr = np.asarray(orders, dtype=np.int32)
+    cap = 0
+    for o in orders:
+        cap += int(np.maximum(lens - o + 1, 0).sum())
+    cap = max(cap, 1)
+    d_u = np.empty(cap, dtype=np.int64)
+    g_u = np.empty(cap, dtype=np.int64)
+    counts = np.empty(cap, dtype=np.int64)
+    m = lib.ks_packed_grams_unique(
+        _ptr(flat), _ptr(tok_off), n_docs,
+        _ptr(orders_arr), len(orders_arr),
+        _ptr(d_u), _ptr(g_u), _ptr(counts),
+    )
+    if m < 0:  # unsupported order: let the numpy path raise its error
+        return None
+    return d_u[:m], g_u[:m], counts[:m]
 
 
 def ngram_hash_features_batch(

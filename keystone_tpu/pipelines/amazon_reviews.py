@@ -24,7 +24,6 @@ from ..data.dataset import Dataset
 from ..evaluation.binary import BinaryClassifierEvaluator
 from ..loaders.text import load_amazon_reviews
 from ..nodes.learning import LogisticRegressionEstimator
-from ..nodes.nlp import LowerCase, Tokenizer, Trim
 from ..nodes.nlp.packed_features import PackedTextFeatures
 
 
@@ -41,21 +40,17 @@ class AmazonReviewsConfig:
 
 
 def build_predictor(train_docs, train_labels, conf: AmazonReviewsConfig):
-    # fused host featurization — output-identical to the composed
-    # NGramsFeaturizer → TermFrequency → CommonSparseFeatures chain
+    # fused host featurization, frontend included: Trim → LowerCase →
+    # Tokenizer run inside PackedTextFeatures' native C pass over the raw
+    # strings; output-identical to the composed node chain
     # (tests/nodes/test_packed_features.py)
     return (
-        Trim()
-        .and_then(LowerCase())
-        .and_then(Tokenizer())
-        .and_then(
-            PackedTextFeatures(
-                list(range(1, conf.n_grams + 1)),
-                conf.common_features,
-                lambda x: 1,
-            ),
-            train_docs,
+        PackedTextFeatures(
+            list(range(1, conf.n_grams + 1)),
+            conf.common_features,
+            lambda x: 1,
         )
+        .with_data(train_docs)
         .and_then(
             LogisticRegressionEstimator(2, num_iters=conf.num_iters),
             train_docs,

@@ -109,3 +109,154 @@ def test_lone_surrogate_tokens_fall_back_to_python():
     batch = tf.apply_batch(Dataset.from_items(docs))
     for row_pairs, doc in zip(_rows(batch.payload), docs):
         assert row_pairs == tf.apply(doc)
+
+
+# ---------------------------------------------------------------------------
+# Fused native text frontend (trim → lower → tokenize → first-seen ids)
+# ---------------------------------------------------------------------------
+
+_FRONTEND_DOCS = [
+    "  Hello, World!  ",
+    "+leading separators keep ONE empty token",
+    "trailing separators drop!!!",
+    "",
+    "   ",
+    "++--++",
+    "a+b a_b a1b 0x7F under_score__double",
+    "repeat repeat REPEAT rePEAT",
+    "tab\tnewline\nmixed \x0b\x0c\r whitespace",
+]
+
+
+def _py_frontend_reference(docs, trim=True, lower=True):
+    from keystone_tpu.nodes.nlp.packed_features import (
+        _py_tokenize_raw,
+        _token_ids,
+    )
+
+    vocab = {}
+    ids = _token_ids(_py_tokenize_raw(docs, trim, lower), vocab, grow=True)
+    return ids, vocab
+
+
+def test_text_frontend_matches_python_chain():
+    from keystone_tpu.native import text_frontend_batch
+
+    res = text_frontend_batch(_FRONTEND_DOCS, [], grow=True)
+    if res is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    ids_flat, tok_off, new_tokens = res
+    want_ids, want_vocab = _py_frontend_reference(_FRONTEND_DOCS)
+    got_ids = np.split(ids_flat, tok_off[1:-1])
+    assert len(got_ids) == len(want_ids)
+    for g, w in zip(got_ids, want_ids):
+        np.testing.assert_array_equal(g, w)
+    want_by_id = [None] * len(want_vocab)
+    for t, i in want_vocab.items():
+        want_by_id[i] = t
+    assert new_tokens == want_by_id
+
+
+def test_text_frontend_lookup_mode_marks_oov():
+    from keystone_tpu.native import text_frontend_batch
+
+    fit = text_frontend_batch(["alpha beta gamma"], [], grow=True)
+    if fit is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    _, _, vocab_tokens = fit
+    res = text_frontend_batch(
+        ["beta unknown alpha"], vocab_tokens, grow=False
+    )
+    ids_flat, tok_off, new_tokens = res
+    assert new_tokens == []
+    np.testing.assert_array_equal(ids_flat, [1, -1, 0])
+
+
+def test_text_frontend_declines_non_ascii():
+    from keystone_tpu.native import text_frontend_batch
+
+    assert text_frontend_batch(["héllo wörld"], [], grow=True) is None
+
+
+def test_packed_features_raw_strings_match_token_list_path():
+    """PackedTextFeatures fed raw strings (fused frontend) must produce
+    IDENTICAL features to the same estimator fed the Python-tokenized
+    lists — on fit-train apply AND on fresh serve docs, native or not."""
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.nlp.packed_features import (
+        PackedTextFeatures,
+        _py_tokenize_raw,
+    )
+
+    train_raw = _FRONTEND_DOCS * 3
+    serve_raw = ["Hello under_score unknownTOKEN b a", "+a b!!"]
+    est_raw = PackedTextFeatures([1, 2], 32, lambda x: 1)
+    est_tok = PackedTextFeatures([1, 2], 32, lambda x: 1)
+    v_raw = est_raw.fit(Dataset.from_items(train_raw))
+    v_tok = est_tok.fit(
+        Dataset.from_items(_py_tokenize_raw(train_raw, True, True))
+    )
+    np.testing.assert_array_equal(v_raw.selected, v_tok.selected)
+    np.testing.assert_array_equal(v_raw.columns, v_tok.columns)
+    for raw_docs, tok_docs in (
+        (train_raw, _py_tokenize_raw(train_raw, True, True)),
+        (serve_raw, _py_tokenize_raw(serve_raw, True, True)),
+    ):
+        r = v_raw.apply_batch(Dataset.from_items(raw_docs)).payload
+        t = v_tok.apply_batch(Dataset.from_items(tok_docs)).payload
+        np.testing.assert_array_equal(
+            np.asarray(r.indices), np.asarray(t.indices)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r.values), np.asarray(t.values)
+        )
+
+
+def test_packed_grams_unique_matches_numpy_path():
+    """Native doc-local gram counting == the numpy corpus-lexsort path,
+    including OOV (-1) drops, orders {1,2,3}, empty docs, and the
+    first-emission uid order the feature selection tie-breaks on."""
+    from keystone_tpu.native import packed_grams_unique
+    from keystone_tpu.nodes.nlp.packed_features import (
+        _corpus_grams,
+        _per_doc_unique,
+    )
+
+    rng = np.random.default_rng(11)
+    ids_list = [
+        rng.integers(-1, 6, size=rng.integers(0, 30)).astype(np.int64)
+        for _ in range(50)
+    ] + [np.empty(0, dtype=np.int64)]
+    for orders in ([1], [1, 2], [1, 2, 3], [2, 3]):
+        res = packed_grams_unique(ids_list, orders)
+        if res is None:
+            import pytest
+
+            pytest.skip("native toolchain unavailable")
+        want = _per_doc_unique(*_corpus_grams(ids_list, orders))
+        for got_a, want_a in zip(res, want):
+            np.testing.assert_array_equal(got_a, want_a)
+
+
+def test_text_frontend_strips_ascii_separator_controls():
+    """\\x1c-\\x1f are str.strip() whitespace AND pure ASCII — the native
+    trim must remove them like the Python spec does."""
+    from keystone_tpu.native import text_frontend_batch
+
+    res = text_frontend_batch(["\x1chello world\x1f"], [], grow=True)
+    if res is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    _, _, new_tokens = res
+    assert new_tokens == ["hello", "world"]
+
+
+def test_packed_grams_unique_rejects_order_4_like_numpy():
+    from keystone_tpu.native import packed_grams_unique
+
+    assert packed_grams_unique([np.arange(5, dtype=np.int64)], [4]) is None
