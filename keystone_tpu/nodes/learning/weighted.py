@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...data.chunked import prefetch_to_device
 from ...data.dataset import Dataset
 from ...linalg.row_matrix import solve_spd
 from ...parallel.mesh import shard_classes
@@ -398,7 +399,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 pop_sum = jnp.zeros((bs,), jnp.float32)
                 row0 = 0
                 with phase("wls.stream_cross") as out:
-                    for chunk in scan():
+                    for chunk in prefetch_to_device(scan()):
                         chunk = jnp.asarray(chunk, dtype=jnp.float32)
                         R, xtR, xtRc, G, class_sums, pop_sum = _wls_scan1(
                             chunk, R,
@@ -450,7 +451,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     )
                     row0 = 0
                     with phase("wls.stream_grams") as out:
-                        for chunk in scan():
+                        for chunk in prefetch_to_device(scan()):
                             chunk = jnp.asarray(chunk, dtype=jnp.float32)
                             grams = _wls_scan2(
                                 chunk, y_idx, grams, row0, j0, c0,

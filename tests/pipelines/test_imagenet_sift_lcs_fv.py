@@ -41,6 +41,79 @@ def test_imagenet_sift_lcs_fv_end_to_end():
     assert out.shape == (48, 5)
 
 
+def test_calibrated_gradient_signal_gates(monkeypatch):
+    """VERDICT r4 #5: a quality signal that (a) has a computable Bayes
+    error, (b) REWARDS the featurizer — raw pixels are near chance because
+    the class signal is a second-order (gradient) statistic — and (c) has
+    teeth: a SIFT whose orientation layer is collapsed must blow the gate."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.sift import SIFTExtractor
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        synthetic_gradient_imagenet,
+    )
+    from keystone_tpu.workflow.env import PipelineEnv
+
+    num_classes = 16
+    gen = dict(num_classes=num_classes, size=48, theta_sigma=0.12,
+               logf_sigma=0.10)
+    tr_i, tr_l, bayes = synthetic_gradient_imagenet(256, seed=1, **gen)
+    te_i, te_l, _ = synthetic_gradient_imagenet(128, seed=2, **gen)
+    conf = ImageNetSiftLcsFVConfig(
+        desc_dim=16, vocab_size=8, num_pca_samples=40_000,
+        num_gmm_samples=40_000, num_classes=num_classes, lam=1e-4,
+    )
+    gate = 2.5 * bayes  # achievable-for-a-working-featurizer band
+
+    pred = build_predictor(tr_i, tr_l, conf)
+    topk = np.asarray(pred(te_i).get().to_array())
+    top1 = 100.0 * float((topk[:, 0] != te_l).mean())
+    assert bayes * 0.5 <= top1 <= gate, (top1, bayes)
+
+    # raw pixels: the same data through a plain linear solve — near chance
+    Xtr = jnp.asarray(tr_i.reshape(len(tr_i), -1), jnp.float32) / 255.0
+    Xte = jnp.asarray(te_i.reshape(len(te_i), -1), jnp.float32) / 255.0
+    Y = ClassLabelIndicators(num_classes).apply_batch(
+        Dataset.of(tr_l)
+    ).to_array()
+    m = LinearMapEstimator(lam=10.0).fit(
+        Dataset.of(Xtr), Dataset.of(jnp.asarray(Y))
+    )
+    raw_err = 100.0 * float(
+        (np.asarray(jnp.argmax(m.trace_batch(Xte), axis=1)) != te_l).mean()
+    )
+    assert raw_err > 2 * top1 and raw_err > 40.0, (raw_err, top1)
+
+    # broken featurizer: average away the 8 orientation bins (layout
+    # t + 8·i + 32·j, sift.py:16) — the gate must catch it
+    PipelineEnv.get_or_create().reset()
+    orig = SIFTExtractor.trace_batch
+
+    def broken(self, X):
+        D = orig(self, X)  # (n, 128, N)
+        n, d, m_ = D.shape
+        D4 = D.reshape(n, d // 8, 8, m_)
+        return jnp.broadcast_to(
+            D4.mean(axis=2, keepdims=True), D4.shape
+        ).reshape(n, d, m_)
+
+    monkeypatch.setattr(SIFTExtractor, "trace_batch", broken)
+    # the fused-executable cache keys on op type+params (not code), so a
+    # monkeypatched trace_batch would otherwise be served the healthy
+    # compiled program
+    from keystone_tpu.workflow.fusion import _FUSED_JIT_CACHE
+
+    _FUSED_JIT_CACHE.clear()
+    broken_topk = np.asarray(
+        build_predictor(tr_i, tr_l, conf)(te_i).get().to_array()
+    )
+    broken_err = 100.0 * float((broken_topk[:, 0] != te_l).mean())
+    assert broken_err > gate, (broken_err, gate)
+
+
 def test_imagenet_fit_from_chunked_source(monkeypatch):
     """Out-of-core fit (VERDICT r4 #1): train images arrive as a
     ChunkedDataset; both featurizer branches run chunk-by-chunk (one
