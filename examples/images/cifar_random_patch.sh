@@ -1,0 +1,16 @@
+#!/bin/bash
+# Canonical RandomPatchCifar launch — the reference config
+# (examples/images/cifar_random_patch.sh:33-37): numFilters=10000,
+# lambda=3000, whiteningEpsilon=1e-5. Binary CIFAR batches under
+# example_data/ train on real data; absent, class-structured synthetic.
+set -e
+: ${NUM_FILTERS:=10000}
+KEYSTONE_DIR="$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )"/../..
+: ${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}
+
+ARGS=(--numFilters "$NUM_FILTERS" --lambda 3000 --whiteningEpsilon 1e-5)
+if [ -f "$EXAMPLE_DATA_DIR/cifar_train.bin" ]; then
+  ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/cifar_train.bin"
+         --testLocation "$EXAMPLE_DATA_DIR/cifar_test.bin")
+fi
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" RandomPatchCifar "${ARGS[@]}"

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Canonical MnistRandomFFT launch (parity: the reference's
+# examples/images/mnist_random_fft.sh config). With the MNIST CSVs present
+# under example_data/ the pipeline trains on real digits; absent (this
+# environment has no egress) it runs the calibrated synthetic task with
+# its analytic Bayes-error gate.
+set -e
+: ${NUM_FFTS:=4}
+: ${BLOCK_SIZE:=2048}
+KEYSTONE_DIR="$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )"/../..
+: ${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}
+
+ARGS=(--numFFTs "$NUM_FFTS" --blockSize "$BLOCK_SIZE")
+if [ -f "$EXAMPLE_DATA_DIR/train-mnist-dense-with-labels.data" ]; then
+  ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/train-mnist-dense-with-labels.data"
+         --testLocation "$EXAMPLE_DATA_DIR/test-mnist-dense-with-labels.data")
+fi
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" MnistRandomFFT "${ARGS[@]}"

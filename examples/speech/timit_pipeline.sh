@@ -1,0 +1,17 @@
+#!/bin/bash
+# Canonical TimitPipeline launch — the reference solver-table shape:
+# cosine random features into the block solver (numCosines x 4096 features,
+# d=16384 at numCosines=4).
+set -e
+: ${NUM_COSINES:=4}
+KEYSTONE_DIR="$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )"/../..
+: ${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}
+
+ARGS=(--numCosines "$NUM_COSINES")
+if [ -f "$EXAMPLE_DATA_DIR/timit-train-features.csv" ]; then
+  ARGS+=(--trainDataLocation "$EXAMPLE_DATA_DIR/timit-train-features.csv"
+         --trainLabelsLocation "$EXAMPLE_DATA_DIR/timit-train-labels.sparse"
+         --testDataLocation "$EXAMPLE_DATA_DIR/timit-test-features.csv"
+         --testLabelsLocation "$EXAMPLE_DATA_DIR/timit-test-labels.sparse")
+fi
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" TimitPipeline "${ARGS[@]}"

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Canonical AmazonReviewsPipeline launch: binary sentiment over review
+# CSVs when present, synthetic reviews otherwise.
+set -e
+: ${NGRAMS:=2}
+: ${COMMON_FEATURES:=100000}
+KEYSTONE_DIR="$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )"/../..
+: ${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}
+
+ARGS=(--nGrams "$NGRAMS" --commonFeatures "$COMMON_FEATURES")
+if [ -f "$EXAMPLE_DATA_DIR/amazon_train.csv" ]; then
+  ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/amazon_train.csv"
+         --testLocation "$EXAMPLE_DATA_DIR/amazon_test.csv")
+fi
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" AmazonReviewsPipeline "${ARGS[@]}"

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Canonical NewsgroupsPipeline launch (parity:
+# examples/text/newsgroups_ngrams_tfidf.sh): 1..2-grams, 100k common
+# features, over the 20news-bydate split when present.
+set -e
+: ${NGRAMS:=2}
+: ${COMMON_FEATURES:=100000}
+KEYSTONE_DIR="$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )"/../..
+: ${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}
+
+ARGS=(--nGrams "$NGRAMS" --commonFeatures "$COMMON_FEATURES")
+if [ -d "$EXAMPLE_DATA_DIR/20news-bydate-train" ]; then
+  ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/20news-bydate-train"
+         --testLocation "$EXAMPLE_DATA_DIR/20news-bydate-test")
+fi
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" NewsgroupsPipeline "${ARGS[@]}"

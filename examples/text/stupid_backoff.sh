@@ -1,0 +1,12 @@
+#!/bin/bash
+# Canonical StupidBackoffPipeline launch: trigram LM with stupid-backoff
+# scoring over a tokenized corpus (synthetic corpus when none given).
+set -e
+KEYSTONE_DIR="$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )"/../..
+: ${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}
+
+ARGS=()
+if [ -f "$EXAMPLE_DATA_DIR/corpus.txt" ]; then
+  ARGS+=(--trainData "$EXAMPLE_DATA_DIR/corpus.txt")
+fi
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" StupidBackoffPipeline "${ARGS[@]}"

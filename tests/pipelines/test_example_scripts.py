@@ -1,0 +1,56 @@
+"""The examples/ launcher scripts (SURVEY §1 layer 7: the reference ships
+per-workload canned configs) must stay in sync with the CLI: every app
+name they dispatch is registered, and every flag they pass exists in the
+target pipeline's argparse. Static checks — the pipelines themselves are
+exercised by their own e2e tests."""
+
+import importlib
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+_APP_MODULES = {
+    "MnistRandomFFT": "mnist_random_fft",
+    "RandomPatchCifar": "random_patch_cifar",
+    "VOCSIFTFisher": "voc_sift_fisher",
+    "ImageNetSiftLcsFV": "imagenet_sift_lcs_fv",
+    "TimitPipeline": "timit",
+    "NewsgroupsPipeline": "newsgroups",
+    "AmazonReviewsPipeline": "amazon_reviews",
+    "StupidBackoffPipeline": "stupid_backoff_pipeline",
+}
+
+
+def _scripts():
+    out = []
+    for root, _, files in os.walk(EXAMPLES):
+        out += [os.path.join(root, f) for f in files if f.endswith(".sh")]
+    return sorted(out)
+
+
+def test_examples_exist():
+    assert len(_scripts()) >= 8
+
+
+@pytest.mark.parametrize("path", _scripts())
+def test_example_script_app_and_flags_exist(path):
+    src = open(path).read()
+    m = re.search(r'run-pipeline\.sh"\s+(\w+)', src)
+    assert m, f"no app dispatch in {path}"
+    app = m.group(1)
+
+    from keystone_tpu.__main__ import PIPELINES
+
+    assert app in PIPELINES, f"{path}: unknown app {app}"
+    module = importlib.import_module(
+        f"keystone_tpu.pipelines.{_APP_MODULES[app]}"
+    )
+    pipeline_src = open(module.__file__).read()
+    for flag in set(re.findall(r"(--[A-Za-z][A-Za-z0-9]*)", src)):
+        assert f'"{flag}"' in pipeline_src, (
+            f"{path}: flag {flag} not in {module.__name__}'s argparse"
+        )
