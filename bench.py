@@ -1107,12 +1107,15 @@ def bench_imagenet_fv() -> dict:
     peak = _device_peak_flops()
     out = {}
     for label, num_classes, image_size, n_train, n_test, note in [
-        ("quality_100c_224px", 100, 224, 500, 128,
+        ("quality_100c_224px", 100, 224, 1000, 128,
          "QUALITY row, generator upgraded this round (VERDICT r4 #5): "
          "class signal in local gradient statistics at known SNR with an "
-         "analytic Bayes error; gated. Rounds 2-4 used fixed gratings "
-         "(trivially separable), so top-5 numbers are not comparable "
-         "round-over-round"),
+         "analytic Bayes error, on a 5-orientation x 20-frequency grid "
+         "the SIFT stack can physically resolve; gated on top-1 vs Bayes "
+         "AND raw-pixels-at-chance. 1000 train images fit through the "
+         "chunked path (descriptor stacks exceed HBM at this count). "
+         "Rounds 2-4 used fixed gratings (trivially separable), so top-5 "
+         "numbers are not comparable round-over-round"),
         ("reference_1000c_256px", 1000, 256, 500, 128,
          "reference config shape (1000 classes, >=256px); 0.5 imgs/class "
          "so top-5 err is NOT meaningful — throughput/MFU row"),
@@ -1129,7 +1132,8 @@ def bench_imagenet_fv() -> dict:
         if calibrated:
             gen_kw = dict(
                 num_classes=num_classes, size=image_size,
-                theta_sigma=0.10, logf_sigma=0.08,
+                theta_sigma=0.09, logf_sigma=0.030,
+                n_theta=5, f_range=(0.06, 0.45),
             )
             tr_i, tr_l, bayes_top1 = synthetic_gradient_imagenet(
                 n_train, seed=1, **gen_kw
@@ -1147,10 +1151,20 @@ def bench_imagenet_fv() -> dict:
             )
         # train batch resident in HBM before the fit timer (the reference's
         # analogue: data cached in RDDs before its timer); upload recorded
+        tr_host = tr_i  # host copy for the raw-pixel baseline (no D2H)
         t0 = time.perf_counter()
         tr_i = jax.device_put(tr_i)
         _fetch_scalar(tr_i)
         t_train_h2d = time.perf_counter() - t0
+        if calibrated:
+            # 1000 images' descriptor stacks exceed HBM if materialized:
+            # fit through the chunked path (images stay device-resident;
+            # chunking slices HBM, featurization runs 64 imgs at a time)
+            from keystone_tpu.data import ChunkedDataset
+
+            tr_fit = ChunkedDataset.from_array(tr_i, 64)
+        else:
+            tr_fit = tr_i
 
         # Two fit attempts with FRESH estimator instances (the pipeline
         # state table is keyed per instance, so the full featurize + EM +
@@ -1165,7 +1179,7 @@ def bench_imagenet_fv() -> dict:
         for _ in range(2):
             timing.reset()
             t0 = time.perf_counter()
-            fitted_i = build_predictor(tr_i, tr_l, conf).fit()
+            fitted_i = build_predictor(tr_fit, tr_l, conf).fit()
             fit_attempts.append(time.perf_counter() - t0)
             fit_phase_attempts.append(timing.snapshot())
             if fitted is None:
@@ -1200,7 +1214,7 @@ def bench_imagenet_fv() -> dict:
                 _DS.of(tr_l)
             ).to_array()
             Xtr_flat = jax.numpy.asarray(
-                np.asarray(tr_i).reshape(n_train, -1), jax.numpy.float32
+                np.asarray(tr_host).reshape(n_train, -1), jax.numpy.float32
             ) / 255.0
             Xte_flat = jax.numpy.asarray(
                 np.asarray(te_i).reshape(n_test, -1), jax.numpy.float32
@@ -1751,14 +1765,28 @@ def bench_text() -> dict:
     }
 
 
+def _section(name, fn):
+    """Run one bench section with stderr progress (stdout stays pure JSON)."""
+    import sys
+
+    t0 = time.perf_counter()
+    print(f"[bench] {name} ...", file=sys.stderr, flush=True)
+    out = fn()
+    print(
+        f"[bench] {name} done in {time.perf_counter() - t0:.1f}s",
+        file=sys.stderr, flush=True,
+    )
+    return out
+
+
 def main() -> int:
-    mnist = bench_mnist()
-    solvers = bench_solvers()
-    krr = bench_krr()
-    imagenet = bench_imagenet_fv()
-    text = bench_text()
-    voc = bench_voc_real_codebook()
-    weak_scaling = bench_weak_scaling()
+    mnist = _section("mnist", bench_mnist)
+    solvers = _section("solvers", bench_solvers)
+    krr = _section("krr", bench_krr)
+    imagenet = _section("imagenet_fv", bench_imagenet_fv)
+    text = _section("text", bench_text)
+    voc = _section("voc", bench_voc_real_codebook)
+    weak_scaling = _section("weak_scaling", bench_weak_scaling)
     print(
         json.dumps(
             {

@@ -294,6 +294,8 @@ def synthetic_gradient_imagenet(
     theta_sigma: float = 0.06,
     logf_sigma: float = 0.05,
     seed: int = 0,
+    n_theta: Optional[int] = None,
+    f_range: Optional[tuple] = None,
 ):
     """Calibrated image generator: the class signal lives ONLY in local
     gradient statistics at a known SNR (VERDICT r4 weak #3).
@@ -316,11 +318,21 @@ def synthetic_gradient_imagenet(
     from math import ceil, erfc, sqrt
 
     rng = np.random.default_rng(seed)
-    n_theta = min(10, max(1, int(np.ceil(np.sqrt(num_classes)))))
+    if n_theta is None:
+        # default square-ish grid; for many classes prefer a coarse θ grid
+        # (SIFT's 8 orientation bins are 45° wide — spacing below ~30°
+        # exceeds the featurizer's angular resolution) via explicit n_theta
+        n_theta = min(10, max(1, int(np.ceil(np.sqrt(num_classes)))))
     n_freq = max(1, ceil(num_classes / n_theta))
     d_theta = np.pi / n_theta
-    log_step = 0.35  # frequency grid spacing in nats
-    f0 = 0.06
+    if f_range is None:
+        log_step = 0.35  # frequency grid spacing in nats
+        f0 = 0.06
+    else:
+        f0, f_hi = f_range
+        log_step = (
+            np.log(f_hi / f0) / max(n_freq - 1, 1) if n_freq > 1 else 0.35
+        )
 
     def tail(delta, sigma):
         # 2·Q(delta/(2·sigma)), the two-sided nearest-neighbor error

@@ -16,11 +16,9 @@ import os, sys
 import numpy as np
 
 import keystone_tpu  # noqa: F401  (registers compile cache)
+import keystone_tpu.nodes.learning.kernel as kernel_mod
 from keystone_tpu.data.dataset import Dataset
-from keystone_tpu.nodes.learning.kernel import (
-    BlockKernelMatrix,
-    KernelRidgeRegression,
-)
+from keystone_tpu.nodes.learning.kernel import KernelRidgeRegression
 
 ckpt_dir = sys.argv[1]
 out_file = sys.argv[2]
@@ -32,16 +30,17 @@ W_true = rng.standard_normal((16, 3)).astype(np.float32)
 Y = (X @ W_true + 0.01 * rng.standard_normal((200, 3))).astype(np.float32)
 
 if kill_after > 0:
-    orig = BlockKernelMatrix.block
+    # the kill seam is the fused fit path's kernel-block generation
+    orig = kernel_mod._kernel_block_slice
     calls = {"n": 0}
 
-    def dying(self, idxs):
+    def dying(X, start, gamma, bs):
         calls["n"] += 1
         if calls["n"] > kill_after:
             os._exit(42)  # hard death: no finally, no atexit
-        return orig(self, idxs)
+        return orig(X, start, gamma, bs)
 
-    BlockKernelMatrix.block = dying
+    kernel_mod._kernel_block_slice = dying
 
 est = KernelRidgeRegression(
     gamma=0.1, lam=1.0, block_size=40, num_epochs=2, block_permuter=5,

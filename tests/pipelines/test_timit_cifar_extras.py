@@ -147,19 +147,22 @@ def test_krr_checkpoint_resume(tmp_path, monkeypatch):
     est = KernelRidgeRegression(
         checkpoint_dir=str(tmp_path), checkpoint_interval=1, **common
     )
-    orig_block = BlockKernelMatrix.block
+    # the kill seam is the fused fit path's kernel-block generation
+    import keystone_tpu.nodes.learning.kernel as kernel_mod
+
+    orig_gen = kernel_mod._kernel_block_slice
     calls = {"n": 0}
 
-    def dying_block(self, idxs):
+    def dying_gen(X_, start, gamma, bs):
         calls["n"] += 1
         if calls["n"] > 4:
             raise RuntimeError("simulated preemption")
-        return orig_block(self, idxs)
+        return orig_gen(X_, start, gamma, bs)
 
-    monkeypatch.setattr(BlockKernelMatrix, "block", dying_block)
+    monkeypatch.setattr(kernel_mod, "_kernel_block_slice", dying_gen)
     with pytest.raises(RuntimeError):
         est.fit(Dataset.of(X), Dataset.of(Y))
-    monkeypatch.setattr(BlockKernelMatrix, "block", orig_block)
+    monkeypatch.setattr(kernel_mod, "_kernel_block_slice", orig_gen)
     assert (tmp_path / "krr_state.npz").exists()
 
     resumed = est.fit(Dataset.of(X), Dataset.of(Y))
