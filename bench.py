@@ -489,21 +489,27 @@ def bench_krr() -> dict:
     # -- timed full fit (2 attempts, fresh estimators; min) -------------
     from keystone_tpu.utils import timing
 
-    timing.enable()
+    # attempts 1-2 run PROFILED (per-phase tables; each phase exit syncs,
+    # adding ~13 transport round trips); attempts 3-4 run clean and carry
+    # the headline timing (measured 1.5 s profiled vs 0.34 s clean)
     fit_attempts = []
     phase_tables = []
     model = None
-    for trial in range(2):
-        timing.reset()
+    for trial in range(4):
+        profiled = trial < 2
+        timing.enable(profiled)
+        if profiled:
+            timing.reset()
         est = KernelRidgeRegression(
-            gamma * (1 + 1e-9 * trial), lam, block_size=bs, num_epochs=1,
-            cache_kernel=False,
+            gamma * (1 + 1e-9 * (trial + 1)), lam, block_size=bs,
+            num_epochs=1, cache_kernel=False,
         )
         t0 = time.perf_counter()
         m_i = est.fit(Dataset.of(Xd), Dataset.of(Yd))
         _fetch_scalar(m_i.W)
         fit_attempts.append(time.perf_counter() - t0)
-        phase_tables.append(timing.snapshot())
+        if profiled:
+            phase_tables.append(timing.snapshot())
         if model is None:
             model = m_i
     timing.enable(False)
@@ -567,7 +573,14 @@ def bench_krr() -> dict:
         "fit_flops": fit_flops,
         "tflops_per_sec": round(fit_flops / t_fit / 1e12, 1),
         "mfu_f32": round(fit_flops / t_fit / peak, 4),
-        "phase_table": phase_tables[fit_attempts.index(t_fit)],
+        "phase_table": phase_tables[
+            fit_attempts[:2].index(min(fit_attempts[:2]))
+        ],
+        "phase_table_note": (
+            "from the best PROFILED attempt (per-phase sync adds ~13 "
+            "round trips); the headline seconds_fit comes from the "
+            "unprofiled attempts"
+        ),
         "exact_single_block_max_dev": exact_dev,
         "train_err_pct_8192": round(100 * train_err, 2),
         "accuracy_ok": bool(exact_dev < 1e-2 and train_err < 0.05),
@@ -1193,17 +1206,21 @@ def bench_imagenet_fv() -> dict:
         else:
             tr_fit = tr_i
 
-        # Two fit attempts with FRESH estimator instances (the pipeline
-        # state table is keyed per instance, so the full featurize + EM +
-        # solve re-executes): attempt 1 carries every first-shape XLA
-        # compile (tens of seconds for the SIFT/LCS stacks), attempt 2 is
-        # the executable-warm cost — the honest steady fit time. Min
-        # reported as the headline, both attempts recorded.
+        # Two fit attempts, each from a COLD pipeline state (the global
+        # state table is reset per attempt — the Cacher-pinned prefixes
+        # would otherwise hand attempt 2 the featurized results and the
+        # "warm fit" would not refeaturize at all): attempt 1 carries
+        # every first-shape XLA compile (tens of seconds for the SIFT/LCS
+        # stacks), attempt 2 is the executable-warm cost — the honest
+        # steady fit time. Min reported as the headline, both recorded.
+        from keystone_tpu.workflow.env import PipelineEnv
+
         timing.enable()  # own scope (no dependence on bench order)
         fit_attempts = []
         fit_phase_attempts = []
         fitted = None
         for _ in range(2):
+            PipelineEnv.get_or_create().reset()
             timing.reset()
             t0 = time.perf_counter()
             fitted_i = build_predictor(tr_fit, tr_l, conf).fit()
@@ -1446,7 +1463,10 @@ def bench_imagenet_fv() -> dict:
                 host_imgs.nbytes / 2**20 / max(t_overlap, 1e-9), 1
             ),
             "compute_share_hidden": round(
-                min((t_serial - t_overlap) / max(hideable, 1e-9), 1.0), 2
+                max(
+                    min((t_serial - t_overlap) / max(hideable, 1e-9), 1.0),
+                    0.0,
+                ), 2
             ),
             "note": (
                 "host uint8 -> prediction. serial = upload/compute/fetch "
@@ -1593,11 +1613,16 @@ def _bench_imagenet_streaming_fit() -> dict:
     chunk_gb = per_img_bytes * chunk / 2**30
     del gray, sift_desc, lcs_desc, chunk0
 
+    from keystone_tpu.workflow.env import PipelineEnv
+
     timing.enable()
     fit_attempts = []
     phase_tables = []
     fitted = None
     for _ in range(2):
+        # cold pipeline state per attempt (see the quality-row comment):
+        # the chunked scans must genuinely re-run for an honest warm time
+        PipelineEnv.get_or_create().reset()
         timing.reset()
         t0 = time.perf_counter()
         fitted_i = build_predictor(tr_ds, tr_l, conf).fit()
