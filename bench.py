@@ -1426,7 +1426,7 @@ def bench_imagenet_fv() -> dict:
         host_imgs = np.asarray(te_i[:n_ing])
         fitted.compile()
         serial_times = []
-        for _ in range(2):
+        for _ in range(3):  # transport stalls dominate 2-trial minima
             t0 = time.perf_counter()
             for i0 in range(0, n_ing, batch_n):
                 chunk = host_imgs[i0 : i0 + batch_n]
@@ -1440,7 +1440,7 @@ def bench_imagenet_fv() -> dict:
             serial_times.append(time.perf_counter() - t0)
         t_serial = min(serial_times)
         overlap_times = []
-        for _ in range(2):
+        for _ in range(3):
             t0 = time.perf_counter()
             o = fitted.apply_chunked(host_imgs, chunk_size=batch_n)
             _fetch_scalar(o.to_array())
@@ -1545,6 +1545,15 @@ def bench_imagenet_fv() -> dict:
             },
             "fused_apply_attempts": [round(t, 4) for t in fused_times],
             "fit_attempts": [round(t, 3) for t in fit_attempts],
+            "fit_attempts_note": (
+                "NOT comparable to rounds 2-4: earlier warm attempts "
+                "silently reused the Cacher-pinned featurized prefixes "
+                "from attempt 1 via the global state table (despite the "
+                "bench claiming a full re-execute); this round resets the "
+                "state per attempt, so the warm number is a TRUE "
+                "refeaturize+refit — a measurement-honesty fix, not a "
+                "perf regression"
+            ),
             "note": note,
             "config": (
                 f"descDim=64 vocabSize=16 (reference defaults); "

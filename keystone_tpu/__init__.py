@@ -43,6 +43,7 @@ def _enable_persistent_compile_cache() -> None:
 
 _enable_persistent_compile_cache()
 
+from .data.chunked import ChunkedDataset
 from .data.dataset import Dataset
 from .workflow import (
     Chainable,
@@ -61,6 +62,7 @@ from .workflow import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "ChunkedDataset",
     "Dataset",
     "Chainable",
     "Pipeline",
