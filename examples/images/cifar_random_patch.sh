@@ -13,4 +13,4 @@ if [ -f "$EXAMPLE_DATA_DIR/cifar_train.bin" ]; then
   ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/cifar_train.bin"
          --testLocation "$EXAMPLE_DATA_DIR/cifar_test.bin")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" RandomPatchCifar "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" RandomPatchCifar "${ARGS[@]}" "$@"

@@ -14,4 +14,4 @@ if [ -d "$EXAMPLE_DATA_DIR/imagenet-train" ]; then
          --testLocation "$EXAMPLE_DATA_DIR/imagenet-test"
          --labelsFile "$EXAMPLE_DATA_DIR/imagenet-labels")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" ImageNetSiftLcsFV "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" ImageNetSiftLcsFV "${ARGS[@]}" "$@"

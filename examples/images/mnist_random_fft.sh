@@ -15,4 +15,4 @@ if [ -f "$EXAMPLE_DATA_DIR/train-mnist-dense-with-labels.data" ]; then
   ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/train-mnist-dense-with-labels.data"
          --testLocation "$EXAMPLE_DATA_DIR/test-mnist-dense-with-labels.data")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" MnistRandomFFT "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" MnistRandomFFT "${ARGS[@]}" "$@"

@@ -11,4 +11,4 @@ if [ -f "$EXAMPLE_DATA_DIR/VOCtrainval_06-Nov-2007.tar" ]; then
          --testLocation "$EXAMPLE_DATA_DIR/VOCtest_06-Nov-2007.tar"
          --labelPath "$EXAMPLE_DATA_DIR/voclabels.csv")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" VOCSIFTFisher "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" VOCSIFTFisher "${ARGS[@]}" "$@"

@@ -14,4 +14,4 @@ if [ -f "$EXAMPLE_DATA_DIR/timit-train-features.csv" ]; then
          --testDataLocation "$EXAMPLE_DATA_DIR/timit-test-features.csv"
          --testLabelsLocation "$EXAMPLE_DATA_DIR/timit-test-labels.sparse")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" TimitPipeline "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" TimitPipeline "${ARGS[@]}" "$@"

@@ -12,4 +12,4 @@ if [ -f "$EXAMPLE_DATA_DIR/amazon_train.csv" ]; then
   ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/amazon_train.csv"
          --testLocation "$EXAMPLE_DATA_DIR/amazon_test.csv")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" AmazonReviewsPipeline "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" AmazonReviewsPipeline "${ARGS[@]}" "$@"

@@ -13,4 +13,4 @@ if [ -d "$EXAMPLE_DATA_DIR/20news-bydate-train" ]; then
   ARGS+=(--trainLocation "$EXAMPLE_DATA_DIR/20news-bydate-train"
          --testLocation "$EXAMPLE_DATA_DIR/20news-bydate-test")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" NewsgroupsPipeline "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" NewsgroupsPipeline "${ARGS[@]}" "$@"

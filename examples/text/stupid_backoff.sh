@@ -9,4 +9,4 @@ ARGS=()
 if [ -f "$EXAMPLE_DATA_DIR/corpus.txt" ]; then
   ARGS+=(--trainData "$EXAMPLE_DATA_DIR/corpus.txt")
 fi
-exec "$KEYSTONE_DIR/bin/run-pipeline.sh" StupidBackoffPipeline "${ARGS[@]}"
+exec "$KEYSTONE_DIR/bin/run-pipeline.sh" StupidBackoffPipeline "${ARGS[@]}" "$@"
