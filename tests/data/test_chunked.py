@@ -115,15 +115,22 @@ def test_from_chunk_fn_deterministic_regeneration():
 def test_align_and_zip_mixed_materialized_branch():
     """A gather where one branch is chunked and another already
     materialized (e.g. its Cacher fit the budget): the materialized side
-    is sliced at the chunked side's boundaries as the scan runs — no
-    probing scan, same rows."""
+    is sliced at the chunked side's boundaries as ONE scan runs (no
+    probing scan — counted), same rows."""
     from keystone_tpu.data.chunked import align_and_zip
 
-    X, a = _src(seed=3)
+    X, base = _src(seed=3)
+    scans = []
+    counted = ChunkedDataset(
+        lambda: (scans.append(1) or iter(p for p in base._payload())),
+        len(base),
+    )
     b = Dataset(jnp.asarray(X * 3.0), batched=True)
-    zipped = align_and_zip([a, b])
-    assert len(zipped) == len(a)
+    zipped = align_and_zip([counted, b])
+    assert len(zipped) == len(base)
+    assert not scans  # lazy until scanned
     chunks = list(zipped.chunks())
+    assert len(scans) == 1  # exactly one scan of the chunked side
     np.testing.assert_allclose(
         np.asarray(jnp.concatenate([c[0] for c in chunks])), X, rtol=1e-6
     )
@@ -136,12 +143,57 @@ def test_align_and_zip_mixed_materialized_branch():
         assert c[0].shape[0] == c[1].shape[0]
 
 
-def test_prefetch_to_device_preserves_order_and_values():
+def test_align_and_zip_error_paths():
+    import pytest
+
+    from keystone_tpu.data.chunked import align_and_zip
+
+    X, a = _src(seed=3)
+    with pytest.raises(ValueError):  # no chunked branch at all
+        align_and_zip([Dataset(jnp.asarray(X), batched=True)])
+    short = Dataset(jnp.asarray(X[:-1]), batched=True)
+    with pytest.raises(ValueError):  # length mismatch
+        align_and_zip([a, short])
+    # misaligned boundaries between two chunked branches, caught mid-scan
+    other = ChunkedDataset.from_array(X, 7)
+    with pytest.raises(ValueError):
+        list(align_and_zip([a, other]).chunks())
+    # three-way: two chunked in lockstep + one materialized slice
+    twin = ChunkedDataset.from_array(X * 2.0, 8)
+    tri = list(
+        align_and_zip(
+            [a, twin, Dataset(jnp.asarray(X * 3.0), batched=True)]
+        ).chunks()
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate([c[1] for c in tri])), X * 2.0, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate([c[2] for c in tri])), X * 3.0, rtol=1e-6
+    )
+
+
+def test_prefetch_to_device_bounded_lookahead_and_device_output():
+    import jax
+
     from keystone_tpu.data.chunked import prefetch_to_device
 
     rng = np.random.default_rng(4)
     chunks = [rng.standard_normal((5, 3)).astype(np.float32) for _ in range(7)]
-    out = list(prefetch_to_device(iter(chunks), depth=3))
+    pulled = []
+
+    def source():
+        for c in chunks:
+            pulled.append(1)
+            yield c
+
+    it = prefetch_to_device(source(), depth=3)
+    first = next(it)
+    # bounded lookahead: at most depth source chunks consumed so far
+    # (+1 for the generator's own readahead slack)
+    assert len(pulled) <= 4, pulled
+    out = [first] + list(it)
     assert len(out) == 7
     for got, want in zip(out, chunks):
+        assert isinstance(got, jax.Array)  # really placed on device
         np.testing.assert_array_equal(np.asarray(got), want)
