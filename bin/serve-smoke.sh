@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Smoke-test the serving engine on CPU: fit a small pipeline, push
+# synthetic traffic through ServingEngine, assert every response matched
+# and every bucket compiled exactly once (the demo exits nonzero on any
+# mismatch). Extra flags pass through to the demo, e.g.:
+#   bin/serve-smoke.sh --requests 128 --buckets 8,32,64
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m keystone_tpu --serve-demo --backend cpu "$@"

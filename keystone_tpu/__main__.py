@@ -125,7 +125,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m keystone_tpu",
         description="Run a pipeline (parity: bin/run-pipeline.sh).",
     )
-    p.add_argument("pipeline", choices=sorted(PIPELINES))
+    # Pre-scan for --serve-demo: in demo mode there is no pipeline
+    # positional, and the demo's own flags (--requests 64, ...) must pass
+    # through parse_known_args without a positional slot swallowing their
+    # values. Accept the same unambiguous prefix abbreviations argparse
+    # would (--serve, --serve-d, ...; no other option starts with --s).
+    def _is_serve_demo_flag(a: str) -> bool:
+        return a.startswith("--s") and "--serve-demo".startswith(a)
+
+    serve_demo = any(_is_serve_demo_flag(a) for a in argv)
+    argv = [a for a in argv if not _is_serve_demo_flag(a)]
+    # registered for -h only; the flag itself is consumed by the pre-scan
+    p.add_argument(
+        "--serve-demo", action="store_true", dest="serve_demo",
+        help="smoke mode: fit a small pipeline and push synthetic traffic "
+             "through the serving engine (see keystone_tpu/serving/); "
+             "replaces the pipeline name",
+    )
+    if not serve_demo:
+        p.add_argument("pipeline", choices=sorted(PIPELINES))
     p.add_argument(
         "--backend", choices=["tpu", "cpu"], default=None,
         help="jax platform; default = whatever jax picks",
@@ -135,7 +153,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="with --backend cpu: virtual device count for a local mesh",
     )
     p.add_argument(
-        "--logLevel", default=None,
+        "--log", "--logLevel", dest="log_level", default=None,
         choices=["debug", "info", "warning", "error"],
         help="log verbosity (default: $KEYSTONE_LOG or warning)",
     )
@@ -147,8 +165,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     args, rest = p.parse_known_args(argv)
     from .utils.obs import configure
 
-    configure(args.logLevel, profile=args.profile or None)
+    configure(args.log_level, profile=args.profile or None)
     _select_backend(args.backend, args.cpuDevices)
+    if serve_demo:
+        from .serving.demo import main as serve_demo_main
+
+        return serve_demo_main(rest)
     return PIPELINES[args.pipeline](rest)
 
 

@@ -17,7 +17,23 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax ≥ 0.6 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax: experimental location
+    from jax.experimental.shard_map import shard_map
+
+# The replication-check knob was renamed check_rep → check_vma in a
+# different release than the top-level export, so pick it off the actual
+# signature rather than the import location.
+import inspect as _inspect
+
+_shmap_params = set(_inspect.signature(shard_map).parameters)
+_SHMAP_CHECK = (
+    {"check_vma": False}
+    if "check_vma" in _shmap_params
+    else {"check_rep": False} if "check_rep" in _shmap_params else {}
+)
 
 from functools import lru_cache
 
@@ -43,7 +59,7 @@ def _tsqr_fn(mesh: Mesh):
         mesh=mesh,
         in_specs=P(DATA_AXIS, None),
         out_specs=P(None, None),
-        check_vma=False,
+        **_SHMAP_CHECK,
     )
     def _tsqr(A_local):
         R_local = jnp.linalg.qr(A_local, mode="r")

@@ -1,0 +1,41 @@
+"""Online serving: micro-batched, shape-bucketed inference over compiled
+pipelines.
+
+The training side of the system already fuses a fitted pipeline into ONE
+jitted XLA program (:meth:`FittedPipeline.compile`); this package is the
+layer that amortizes that program across concurrent request traffic:
+
+* :class:`ServingEngine` — bounded admission queue + worker loop that
+  drains requests into micro-batches (max batch size, max-wait timeout),
+  with per-request deadlines, backpressure, and per-request error
+  isolation.
+* :class:`BucketPolicy` — pads micro-batches to a small static set of
+  bucket shapes so the compiled function traces once per bucket (XLA
+  specializes per shape; without bucketing every new batch size pays a
+  full recompile under live traffic).
+* :class:`MetricsRegistry` — queue depth, batch occupancy, compile count,
+  and p50/p95/p99 request latency, with a programmatic ``snapshot()`` and
+  periodic INFO logging.
+"""
+
+from .batching import BucketPolicy
+from .engine import ServingEngine
+from .errors import (
+    DeadlineExceeded,
+    EngineClosed,
+    InvalidRequest,
+    QueueFull,
+    ServingError,
+)
+from .metrics import MetricsRegistry
+
+__all__ = [
+    "ServingEngine",
+    "BucketPolicy",
+    "MetricsRegistry",
+    "ServingError",
+    "QueueFull",
+    "DeadlineExceeded",
+    "InvalidRequest",
+    "EngineClosed",
+]

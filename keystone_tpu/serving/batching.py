@@ -1,0 +1,108 @@
+"""Shape bucketing: a small static set of batch shapes for the compiled fn.
+
+XLA specializes every program to its input shapes, so a naive serving loop
+that stacks whatever requests happen to be in the queue presents a new
+batch size — and pays a full recompile — almost every batch (tens of
+seconds for the image stacks). The policy here is the standard fix: round
+every micro-batch up to the next of a few configured bucket sizes by
+padding rows, so the jitted function traces once per bucket, ever, and
+steady-state traffic runs with ZERO compiles. ``warmup_inputs`` lets the
+engine pay all of those compiles before admitting traffic.
+
+Padding repeats the batch's first row (same trick as
+``FittedPipeline.apply_chunked``): padded rows stay in-distribution for
+any row-wise chain and are sliced off before results are returned.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .errors import InvalidRequest
+
+
+class BucketPolicy:
+    """Pads micro-batches to static bucket sizes; validates request data.
+
+    ``datum_shape`` (per-item shape, no batch dim) may be given up front —
+    enabling warm-up before any traffic — or left None, in which case it
+    locks to the first valid datum seen and warm-up is skipped.
+    """
+
+    def __init__(
+        self,
+        batch_sizes: Sequence[int] = (1, 8, 32, 64),
+        datum_shape: Optional[Sequence[int]] = None,
+        dtype: Any = np.float32,
+    ):
+        sizes = sorted(set(int(b) for b in batch_sizes))
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"bucket sizes must be positive ints, got {batch_sizes!r}")
+        self.batch_sizes: Tuple[int, ...] = tuple(sizes)
+        self.datum_shape: Optional[Tuple[int, ...]] = (
+            tuple(int(d) for d in datum_shape) if datum_shape is not None else None
+        )
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def max_size(self) -> int:
+        return self.batch_sizes[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket holding ``n`` rows. The engine never gathers
+        more than ``max_size`` requests per batch, so ``n`` always fits."""
+        if n < 1:
+            raise ValueError("empty batch has no bucket")
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the largest bucket ({self.max_size}); "
+            "the engine must split it"
+        )
+
+    # -- validation -----------------------------------------------------
+
+    def validate(self, datum: Any) -> np.ndarray:
+        """Convert one request datum to the service's array contract, or
+        raise :class:`InvalidRequest`. Locks ``datum_shape`` on first use
+        when it was not configured."""
+        try:
+            arr = np.asarray(datum, dtype=self.dtype)
+        except (TypeError, ValueError) as e:
+            raise InvalidRequest(f"datum not castable to {self.dtype}: {e}") from e
+        if self.datum_shape is None:
+            self.datum_shape = tuple(arr.shape)
+        elif tuple(arr.shape) != self.datum_shape:
+            raise InvalidRequest(
+                f"datum shape {tuple(arr.shape)} != service shape {self.datum_shape}"
+            )
+        return arr
+
+    # -- padding / warm-up ----------------------------------------------
+
+    def pad(self, stacked: np.ndarray, bucket: int) -> np.ndarray:
+        """Pad ``stacked`` (n ≤ bucket rows) up to ``bucket`` rows by
+        repeating its first row."""
+        n = int(stacked.shape[0])
+        if n == bucket:
+            return stacked
+        if n > bucket:
+            raise ValueError(f"{n} rows do not fit bucket {bucket}")
+        return np.concatenate(
+            [stacked, np.repeat(stacked[:1], bucket - n, axis=0)], axis=0
+        )
+
+    def warmup_inputs(self) -> Iterator[np.ndarray]:
+        """One zero batch per bucket, in the exact shape+dtype live
+        traffic will present — running these through the compiled fn
+        pre-pays every compile the policy allows."""
+        if self.datum_shape is None:
+            raise ValueError(
+                "warm-up needs datum_shape; configure it or serve a first "
+                "request to lock the shape"
+            )
+        for b in self.batch_sizes:
+            yield np.zeros((b, *self.datum_shape), dtype=self.dtype)

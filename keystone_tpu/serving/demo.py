@@ -1,0 +1,91 @@
+"""``--serve-demo``: fit a small pipeline, push synthetic traffic through
+the engine, print the metrics snapshot. The smoke path behind
+``bin/serve-smoke.sh`` and the CLI's ``--serve-demo`` flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("keystone-tpu serve-demo")
+    p.add_argument("--numFFTs", type=int, default=2)
+    p.add_argument("--blockSize", type=int, default=512)
+    p.add_argument("--lambda", dest="lam", type=float, default=100.0)
+    p.add_argument("--nTrain", type=int, default=2048)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--buckets", default="8,32",
+                   help="comma-separated static batch-size buckets")
+    p.add_argument("--maxQueue", type=int, default=256)
+    p.add_argument("--maxWaitMs", type=float, default=2.0)
+    p.add_argument("--clients", type=int, default=8,
+                   help="concurrent submitter threads")
+    args = p.parse_args(argv)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+
+    from ..nodes.util import ClassLabelIndicators, MaxClassifier
+    from ..nodes.learning.linear import BlockLeastSquaresEstimator
+    from ..pipelines.mnist_random_fft import (
+        NUM_CLASSES,
+        MnistRandomFFTConfig,
+        build_featurizer,
+        synthetic_mnist_device,
+    )
+    from .engine import ServingEngine
+
+    conf = MnistRandomFFTConfig(
+        num_ffts=args.numFFTs, block_size=args.blockSize, lam=args.lam
+    )
+    train, test = synthetic_mnist_device(
+        n_train=args.nTrain, n_test=max(args.requests, 64)
+    )
+    labels = ClassLabelIndicators(NUM_CLASSES).apply_batch(train.labels)
+    fitted = (
+        build_featurizer(conf)
+        .and_then(BlockLeastSquaresEstimator(conf.block_size, 1, conf.lam or 0.0),
+                  train.data, labels)
+        .and_then(MaxClassifier())
+        .fit()
+    )
+
+    data = np.asarray(test.data.to_array())[: args.requests]
+    engine = ServingEngine(
+        fitted,
+        buckets=buckets,
+        datum_shape=data.shape[1:],
+        max_queue=args.maxQueue,
+        max_wait_ms=args.maxWaitMs,
+    )
+    with engine:
+        with ThreadPoolExecutor(max_workers=args.clients) as pool:
+            preds = list(pool.map(lambda row: engine.predict(row, timeout=60.0), data))
+
+    expected = np.asarray(fitted.apply(data).to_array()) if len(data) else np.array([])
+    agree = int(np.sum(np.asarray(preds).ravel() == expected.ravel()))
+    snap = engine.metrics.snapshot()
+    c = snap["counters"]
+    lat = snap["latency"]
+    occ = snap["batch_occupancy"]["ratio"]
+    print(
+        f"SERVE ok={agree}/{len(data)} compiles={c.get('compiles', 0)} "
+        f"batches={c.get('batches', 0)} completed={c.get('completed', 0)} "
+        f"occupancy={'n/a' if occ is None else format(occ, '.3f')} "
+        f"p50={lat.get('p50', 0):.4f}s p99={lat.get('p99', 0):.4f}s"
+    )
+    ok = (
+        agree == len(data)
+        and c.get("completed", 0) == len(data)
+        # policy dedups bucket sizes, so compare against what it kept
+        and c.get("compiles", 0) == len(engine.policy.batch_sizes)
+    )
+    print("SERVE " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

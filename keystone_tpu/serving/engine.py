@@ -1,0 +1,393 @@
+"""The serving engine: admission queue → micro-batches → compiled pipeline.
+
+Lifecycle: construct (compiles the pipeline strictly — an untraceable
+chain fails HERE with :class:`NotTraceableError`, not per-request under
+traffic), ``start()`` (pre-compiles every bucket, then admits traffic),
+``submit``/``predict`` from any number of threads, ``drain()`` /
+``shutdown()``. Also a context manager: ``with engine:`` starts and
+drains.
+
+Batching policy: the worker blocks for the first queued request, then
+gathers more until either the largest bucket is full or ``max_wait_ms``
+elapses — the classic micro-batching latency/throughput knob. Backpressure
+is reject-at-admission (:class:`QueueFull`) on a bounded queue, never
+unbounded growth. Requests carry optional deadlines; a request that
+expires while queued gets :class:`DeadlineExceeded` instead of wasting a
+batch slot. A datum that fails validation gets :class:`InvalidRequest`
+while the REST of its micro-batch completes — per-request error isolation.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+from ..utils import timing
+from ..workflow.pipeline import FittedPipeline, NotTraceableError
+from .batching import BucketPolicy
+from .errors import DeadlineExceeded, EngineClosed, InvalidRequest, QueueFull
+from .metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Request:
+    datum: Any
+    deadline: Optional[float]  # time.monotonic() timestamp, or None
+    enqueued: float
+    future: Future = field(default_factory=Future)
+
+
+class ServingEngine:
+    """Serves a :class:`FittedPipeline` to concurrent callers.
+
+    Parameters
+    ----------
+    fitted:
+        The estimator-free pipeline; compiled strictly at construction.
+    buckets:
+        Static batch-size buckets (largest = max micro-batch size).
+    datum_shape / dtype:
+        Per-item array contract. With ``datum_shape`` given, ``start()``
+        pre-compiles every bucket before traffic; without it, the shape
+        locks to the first request (first batch then pays its compile).
+    max_queue:
+        Admission-queue bound; submissions beyond it raise
+        :class:`QueueFull`.
+    max_wait_ms:
+        Micro-batch gather window after the first request of a batch.
+    """
+
+    def __init__(
+        self,
+        fitted: FittedPipeline,
+        *,
+        buckets: Sequence[int] = (1, 8, 32, 64),
+        datum_shape: Optional[Sequence[int]] = None,
+        dtype: Any = "float32",
+        max_queue: int = 256,
+        max_wait_ms: float = 2.0,
+        metrics: Optional[MetricsRegistry] = None,
+        log_interval_s: float = 10.0,
+    ):
+        self._fitted = fitted
+        # same hazard apply_chunked guards: bucket padding repeats rows, so
+        # a node computing whole-batch statistics would silently fold the
+        # padding into every real request's answer
+        coupled = fitted.batch_coupled_nodes()
+        if coupled:
+            raise ValueError(
+                f"cannot serve a batch-coupled chain ({coupled[0]}): bucket "
+                "padding would corrupt its whole-batch statistics — use "
+                "FittedPipeline.apply() instead"
+            )
+        if max_queue < 1:
+            # Queue(maxsize=0) would mean UNBOUNDED in python — the exact
+            # opposite of the backpressure contract
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._policy = BucketPolicy(buckets, datum_shape, dtype)
+        self._metrics = metrics or MetricsRegistry()
+        # Strict compile: fail at construction, naming the blocking node,
+        # rather than degrading per-call under traffic. The jit is PRIVATE
+        # to this engine — fitted.compile() would hijack the pipeline's own
+        # compiled state, letting unrelated apply_compiled/apply_chunked
+        # calls pollute this engine's compile accounting (and a second
+        # engine discard the first's warm cache). Every XLA trace — one per
+        # distinct padded shape — records its signature and bumps the
+        # "compiles" counter, the invariant the bucket policy protects.
+        import jax
+
+        fn = fitted.trace_fn()
+        if fn is None:
+            raise NotTraceableError(fitted.untraceable_nodes())
+        signatures: list = []
+        self._compiled_signatures = signatures
+        metrics_ref = self._metrics
+
+        def _traced(x):
+            signatures.append((tuple(x.shape), str(x.dtype)))
+            metrics_ref.inc("compiles")
+            return fn(x)
+
+        self._compiled = jax.jit(_traced)
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+        self._max_wait = max_wait_ms / 1000.0
+        self._log_interval = log_interval_s
+        # orders every admission against the _closed flip in drain/shutdown:
+        # a put either completes before _closed is set (and is answered by
+        # the drain) or observes _closed and is rejected — no request can
+        # land in the queue after the post-join sweep
+        self._admit_lock = threading.Lock()
+        # serializes start/drain/shutdown against each other (e.g. an
+        # atexit handler racing the context manager's __exit__)
+        self._lifecycle_lock = threading.RLock()
+        self._closed = False
+        self._abort = False
+        self._stop = False
+        self._ran = False  # distinguishes never-started from shut-down
+        self._thread: Optional[threading.Thread] = None
+        self._metrics.set_gauge("queue_depth", self._queue.qsize)
+
+    @property
+    def metrics(self) -> MetricsRegistry:
+        return self._metrics
+
+    @property
+    def policy(self) -> BucketPolicy:
+        return self._policy
+
+    @property
+    def compiled_signatures(self) -> list:
+        """``(shape, dtype)`` of every trace this engine's jit paid, in
+        compile order — len() equals the metrics ``compiles`` counter."""
+        return list(self._compiled_signatures)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def warm_up(self) -> int:
+        """Run one zero batch per bucket through the compiled fn, paying
+        every bucket's compile before traffic. Returns buckets warmed (0
+        when the datum shape is not configured yet)."""
+        import jax
+
+        if self._policy.datum_shape is None:
+            logger.warning(
+                "serving warm-up skipped: no datum_shape configured — the "
+                "first live batch of each bucket will pay its compile"
+            )
+            return 0
+        n = 0
+        for x in self._policy.warmup_inputs():
+            jax.block_until_ready(self._compiled(x))
+            n += 1
+        logger.info(
+            "serving warm-up: %d bucket(s) %s compiled (%d traces total)",
+            n, self._policy.batch_sizes, self._metrics.count("compiles"),
+        )
+        return n
+
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        with self._lifecycle_lock:
+            if self._thread is not None:
+                raise RuntimeError("engine already started")
+            if self._closed:
+                raise EngineClosed("engine was shut down")
+            if warmup:
+                self.warm_up()
+            self._thread = threading.Thread(
+                target=self._worker_loop, name="keystone-serving-worker",
+                daemon=True,
+            )
+            self._thread.start()
+            self._ran = True
+        return self
+
+    def drain(self) -> None:
+        """Stop admitting, answer every queued request, stop the worker.
+        Equivalent to ``shutdown(drain=True)`` — a drained engine must not
+        leave its worker polling an empty queue for the process lifetime."""
+        self.shutdown(drain=True)
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop the engine. ``drain=True`` answers queued requests first;
+        ``drain=False`` fails them with :class:`EngineClosed`. Idempotent
+        and safe to call from multiple threads."""
+        with self._lifecycle_lock:
+            with self._admit_lock:
+                self._closed = True
+            if self._thread is None:
+                self._reject_queued(
+                    "engine is shut down" if self._ran else "engine never started"
+                )
+                return
+            if drain:
+                self._queue.join()
+            else:
+                self._abort = True
+            self._stop = True
+            self._thread.join()
+            self._thread = None
+            # _admit_lock ordered every put against the _closed flip above,
+            # so nothing can land after this point; the sweep is a belt-and-
+            # braces guarantee no admitted request is ever left unanswered.
+            self._reject_queued()
+
+    def _reject_queued(self, reason: str = "engine is shut down") -> None:
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(EngineClosed(reason))
+            self._queue.task_done()
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+    # -- admission ------------------------------------------------------
+
+    def submit(self, datum: Any, timeout: Optional[float] = None) -> Future:
+        """Enqueue one datum; returns a Future of its prediction row.
+
+        ``timeout`` (seconds) is the request's deadline: if the batch it
+        would join runs after the deadline, the Future fails with
+        :class:`DeadlineExceeded`. Raises :class:`QueueFull` immediately
+        when the admission queue is at capacity."""
+        now = time.monotonic()
+        req = _Request(
+            datum=datum,
+            deadline=(now + timeout) if timeout is not None else None,
+            enqueued=now,
+        )
+        with self._admit_lock:
+            if self._closed:
+                raise EngineClosed("engine is draining / shut down")
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                self._metrics.inc("rejected")
+                raise QueueFull(
+                    f"admission queue at capacity ({self._queue.maxsize})"
+                ) from None
+        self._metrics.inc("submitted")
+        return req.future
+
+    def predict(self, datum: Any, timeout: Optional[float] = None) -> Any:
+        """Synchronous convenience: submit + wait for the result.
+
+        On a STARTED engine every admitted request reaches a terminal
+        state — a result or a typed :mod:`~keystone_tpu.serving.errors`
+        exception (deadline expiry is decided by the worker at batch
+        time; shutdown sweeps the queue) — so this waits without its own
+        deadline. A compile in flight can legitimately hold a
+        first-of-bucket request for tens of seconds; warm up to avoid
+        that. ``submit()`` MAY buffer before ``start()`` (the futures
+        resolve once the worker runs), but a synchronous wait then has
+        nothing to wake it, so this raises instead."""
+        if self._thread is None:
+            raise RuntimeError(
+                "predict() needs a started engine (call start() or use "
+                "the context manager); submit() may buffer before start"
+            )
+        return self.submit(datum, timeout=timeout).result()
+
+    # -- worker ---------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop:
+                    return
+                continue
+            if self._abort:
+                self._fail_and_drain(first)
+                continue
+            batch = [first]
+            gather_until = time.monotonic() + self._max_wait
+            while len(batch) < self._policy.max_size:
+                remaining = gather_until - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            try:
+                self._run_batch(batch)
+            except BaseException:  # _run_batch isolates; this is the backstop
+                logger.exception("serving worker: unexpected batch failure")
+                for r in batch:
+                    if not r.future.done():
+                        try:
+                            r.future.set_exception(
+                                EngineClosed("internal batch failure")
+                            )
+                        except Exception:
+                            pass
+            finally:
+                for _ in batch:
+                    self._queue.task_done()
+            try:
+                # user-registered gauges run inside snapshot(); an exception
+                # there must not kill the only worker thread
+                self._metrics.maybe_log(self._log_interval)
+            except Exception:
+                logger.exception("serving worker: metrics logging failed")
+
+    def _fail_and_drain(self, first: _Request) -> None:
+        """Abortive shutdown: answer everything queued with EngineClosed."""
+        reqs = [first]
+        while True:
+            try:
+                reqs.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for r in reqs:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(EngineClosed("engine aborted"))
+            self._queue.task_done()
+
+    def _run_batch(self, batch: Sequence[_Request]) -> None:
+        import jax
+        import numpy as np
+
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if not r.future.set_running_or_notify_cancel():
+                self._metrics.inc("cancelled")
+                continue
+            if r.deadline is not None and now > r.deadline:
+                self._metrics.inc("expired")
+                r.future.set_exception(
+                    DeadlineExceeded(
+                        f"deadline passed {now - r.deadline:.4f}s before batching"
+                    )
+                )
+                continue
+            live.append(r)
+
+        valid, rows = [], []
+        for r in live:
+            try:
+                rows.append(self._policy.validate(r.datum))
+                valid.append(r)
+            except InvalidRequest as e:
+                self._metrics.inc("invalid")
+                r.future.set_exception(e)
+        if not valid:
+            return
+
+        bucket = self._policy.bucket_for(len(valid))
+        padded = self._policy.pad(np.stack(rows), bucket)
+        try:
+            with timing.phase("serve.batch") as hold:
+                out = self._compiled(padded)
+                hold.append(out)
+            out = jax.device_get(out)  # one D2H fetch for the whole batch
+        except Exception as e:  # batch-level failure → every member errors
+            self._metrics.inc("batch_errors")
+            for r in valid:
+                r.future.set_exception(e)
+            return
+
+        done = time.monotonic()
+        for i, r in enumerate(valid):
+            r.future.set_result(
+                jax.tree_util.tree_map(lambda a: a[i], out)
+            )
+            self._metrics.observe_latency(done - r.enqueued)
+        self._metrics.inc("completed", len(valid))
+        self._metrics.observe_batch(len(valid), bucket)

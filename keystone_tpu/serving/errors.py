@@ -1,0 +1,34 @@
+"""Typed serving errors.
+
+Every way a request can fail without the engine itself being broken gets
+its own type, so callers can branch (retry / shed / fix the datum) instead
+of string-matching, and so a failed request NEVER stalls the worker loop —
+the error becomes that request's result and the batch continues.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(Exception):
+    """Base of all serving-layer errors."""
+
+
+class QueueFull(ServingError):
+    """Admission queue at capacity — the request was rejected at submit
+    time (backpressure by load-shedding, never unbounded growth)."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed while it waited in the queue; it was
+    dropped before wasting a batch slot on an answer nobody is waiting
+    for."""
+
+
+class InvalidRequest(ServingError):
+    """The request's datum failed validation (wrong shape / uncastable
+    payload). Isolated per request: the rest of its micro-batch completes
+    normally."""
+
+
+class EngineClosed(ServingError):
+    """Submit after :meth:`ServingEngine.drain` / ``shutdown``."""

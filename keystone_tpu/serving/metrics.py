@@ -1,0 +1,134 @@
+"""Serving metrics: counters, gauges, latency quantiles, phase export.
+
+Parity note: the reference inherits per-stage counters and timelines from
+the Spark UI; here a process-local registry plays that role for the
+serving path. Everything is thread-safe (the engine's worker thread and N
+submitter threads write concurrently), ``snapshot()`` is the programmatic
+read used by tests and the demo, and ``maybe_log`` emits a rate-limited
+one-line INFO summary through the same stdlib logging that
+``utils.obs.configure`` levels.
+
+Phase stats from ``utils.timing`` (the hot-solver profiling registry) are
+embedded in every snapshot under ``"phases"`` — the engine wraps its batch
+execution in ``timing.phase("serve.batch", ...)``, so under
+``KEYSTONE_PROFILE=1`` the serving batches show up in the same per-phase
+device-time table as the solvers.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from collections import defaultdict, deque
+from typing import Callable, Dict, Optional
+
+from ..utils import timing
+from ..utils.obs import every
+
+logger = logging.getLogger(__name__)
+
+#: quantiles reported by :meth:`MetricsRegistry.latency_quantiles`
+_QUANTILES = (0.50, 0.95, 0.99)
+
+
+class MetricsRegistry:
+    """Thread-safe counters + gauges + a bounded latency reservoir."""
+
+    def __init__(self, name: str = "serving", latency_window: int = 4096):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._latencies: deque = deque(maxlen=latency_window)
+        self._batch_items = 0
+        self._batch_capacity = 0
+
+    # -- writes ---------------------------------------------------------
+
+    def inc(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[counter] += n
+
+    def set_gauge(self, name: str, read: Callable[[], float]) -> None:
+        """Register a live-value gauge (e.g. queue depth); ``read`` is
+        called at snapshot time."""
+        with self._lock:
+            self._gauges[name] = read
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def observe_batch(self, items: int, capacity: int) -> None:
+        """One executed micro-batch: ``items`` real rows in a
+        ``capacity``-row bucket. The running ratio is batch occupancy —
+        how much of each compiled program's work is real traffic vs
+        padding."""
+        with self._lock:
+            self._counters["batches"] += 1
+            self._batch_items += items
+            self._batch_capacity += capacity
+
+    # -- reads ----------------------------------------------------------
+
+    def count(self, counter: str) -> int:
+        with self._lock:
+            return self._counters[counter]
+
+    def latency_quantiles(self) -> Dict[str, float]:
+        with self._lock:
+            lat = sorted(self._latencies)
+        out: Dict[str, float] = {"count": len(lat)}
+        if not lat:
+            return out
+        out["mean"] = sum(lat) / len(lat)
+        for q in _QUANTILES:
+            # nearest-rank: ceil(q*n)-1, clamped (int(q*n) alone is biased
+            # one rank high — p99 of a full window would report the max)
+            idx = min(len(lat) - 1, max(0, math.ceil(q * len(lat)) - 1))
+            out[f"p{int(q * 100)}"] = lat[idx]
+        return out
+
+    def snapshot(self) -> Dict[str, object]:
+        """Everything at once: counters, evaluated gauges, occupancy,
+        latency quantiles, and the process phase-timing table."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = list(self._gauges.items())
+            items, capacity = self._batch_items, self._batch_capacity
+        return {
+            "name": self.name,
+            "counters": counters,
+            "gauges": {k: read() for k, read in gauges},
+            "batch_occupancy": {
+                "items": items,
+                "capacity": capacity,
+                "ratio": (items / capacity) if capacity else None,
+            },
+            "latency": self.latency_quantiles(),
+            "phases": timing.snapshot(prefix="serve."),
+        }
+
+    # -- periodic logging ----------------------------------------------
+
+    def maybe_log(self, interval_s: float = 10.0) -> bool:
+        """Log a one-line INFO summary, at most once per ``interval_s``
+        per registry instance (two engines with the same registry name
+        must not suppress each other's summaries). Returns True when it
+        logged."""
+        if not every(f"metrics:{self.name}:{id(self)}", interval_s):
+            return False
+        snap = self.snapshot()
+        lat = snap["latency"]
+        occ = snap["batch_occupancy"]["ratio"]
+        logger.info(
+            "%s: counters=%s queue=%s occupancy=%s p50=%s p99=%s",
+            self.name,
+            snap["counters"],
+            snap["gauges"].get("queue_depth"),
+            None if occ is None else round(occ, 3),
+            round(lat["p50"], 4) if "p50" in lat else None,
+            round(lat["p99"], 4) if "p99" in lat else None,
+        )
+        return True

@@ -24,12 +24,31 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Optional
+import threading
+import time
+from typing import Dict, Optional
 
 _FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
 _DATEFMT = "%H:%M:%S"
 
 _configured = False
+
+_every_lock = threading.Lock()
+_every_last: Dict[str, float] = {}
+
+
+def every(key: str, seconds: float) -> bool:
+    """Process-wide rate limiter for periodic logs: True at most once per
+    ``seconds`` for a given ``key`` (first call always True). Lets hot
+    loops (the serving engine's worker, long solver scans) emit periodic
+    INFO summaries without flooding at per-iteration rate."""
+    now = time.monotonic()
+    with _every_lock:
+        last = _every_last.get(key)
+        if last is not None and now - last < seconds:
+            return False
+        _every_last[key] = now
+        return True
 
 
 def configure(level: Optional[str] = None, profile: Optional[bool] = None) -> None:

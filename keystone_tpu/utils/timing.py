@@ -80,10 +80,15 @@ def reset() -> None:
         _counts.clear()
 
 
-def snapshot() -> Dict[str, Dict[str, float]]:
-    """{phase: {"seconds": total, "calls": n}} — what the bench embeds."""
+def snapshot(prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+    """{phase: {"seconds": total, "calls": n}} — what the bench embeds.
+
+    ``prefix`` filters to one subsystem's phases (e.g. ``"serve."`` for
+    the serving engine's metric snapshots), so a service's metrics export
+    doesn't drag every solver phase of the process along."""
     with _lock:
         return {
             k: {"seconds": round(_totals[k], 4), "calls": _counts[k]}
             for k in sorted(_totals)
+            if prefix is None or k.startswith(prefix)
         }

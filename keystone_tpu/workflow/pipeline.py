@@ -36,6 +36,28 @@ from . import analysis
 logger = logging.getLogger(__name__)
 
 
+class NotTraceableError(ValueError):
+    """A pipeline contains nodes without ``trace_batch`` and therefore cannot
+    compile to one XLA computation. Carries the offending node labels so a
+    caller (e.g. the serving engine) can report exactly which stage blocks
+    compilation. Subclasses :class:`ValueError` so pre-existing
+    ``except ValueError`` callers of :meth:`FittedPipeline.compile` keep
+    working."""
+
+    def __init__(self, labels: Sequence[str]):
+        self.labels = list(labels)
+        super().__init__(
+            "pipeline not traceable: "
+            + ", ".join(self.labels)
+            + " lack(s) trace_batch"
+        )
+
+    def __reduce__(self):
+        # default exception reduction would re-call __init__ with the
+        # formatted message, turning .labels into a list of characters
+        return (NotTraceableError, (self.labels,))
+
+
 # ---------------------------------------------------------------------------
 # Lazy results
 # ---------------------------------------------------------------------------
@@ -329,6 +351,9 @@ class FittedPipeline(Chainable):
         self._source = source
         self._sink = sink
         self._compiled: Optional[Callable] = None
+        #: one entry per XLA trace of the compiled function — ``(shape, dtype)``
+        #: of the stacked input. len() == number of compiles paid so far.
+        self._compiled_signatures: List[tuple] = []
 
     @property
     def graph(self) -> Graph:
@@ -367,21 +392,48 @@ class FittedPipeline(Chainable):
 
     # -- compilation ----------------------------------------------------
 
+    def batch_coupled_nodes(self) -> List[str]:
+        """Labels of nodes whose ``trace_batch`` couples rows (whole-batch
+        statistics). Such chains must not be served through any
+        pad-and-slice path (:meth:`apply_chunked`, the serving engine's
+        bucket padding) — padded rows would silently fold into every real
+        row's answer."""
+        labels = []
+        for node in self._graph.nodes:
+            op = self._graph.get_operator(node)
+            if getattr(op, "batch_coupled", False):
+                labels.append(op.label)
+        return labels
+
+    def untraceable_nodes(self) -> List[str]:
+        """Labels of nodes that block whole-chain compilation (no
+        ``trace_batch``). Empty list ⇒ the pipeline compiles."""
+        labels = []
+        for node in self._graph.nodes:
+            op = self._graph.get_operator(node)
+            if isinstance(op, GatherTransformerOperator):
+                continue
+            if getattr(op, "trace_batch", None) is None:
+                labels.append(op.label)
+        return labels
+
+    @property
+    def is_traceable(self) -> bool:
+        return not self.untraceable_nodes()
+
     def trace_fn(self) -> Optional[Callable]:
         """Build one pure function (stacked-array in → stacked-array out) from
         the transformer DAG, if every node exposes ``trace_batch``.
 
-        Returns None when any node is untraceable (host-side, ragged, ...).
+        Returns None when any node is untraceable (host-side, ragged, ...);
+        :meth:`untraceable_nodes` names the blockers.
         """
         graph, source, sink = self._graph, self._source, self._sink
 
-        for node in graph.nodes:
-            op = graph.get_operator(node)
-            if isinstance(op, GatherTransformerOperator):
-                continue
-            if getattr(op, "trace_batch", None) is None:
-                logger.info("pipeline not traceable: %s has no trace_batch", op.label)
-                return None
+        blockers = self.untraceable_nodes()
+        if blockers:
+            logger.debug("pipeline not traceable: %s", ", ".join(blockers))
+            return None
 
         order = [n for n in analysis.linearize(graph) if isinstance(n, NodeId)]
 
@@ -398,15 +450,60 @@ class FittedPipeline(Chainable):
 
         return fn
 
-    def compile(self) -> Callable:
-        """Jit the composed transformer chain into one XLA computation."""
+    def compile(
+        self,
+        strict: bool = True,
+        on_trace: Optional[Callable[[tuple], None]] = None,
+    ) -> Optional[Callable]:
+        """Jit the composed transformer chain into one XLA computation.
+
+        ``strict=True`` (default) raises :class:`NotTraceableError` naming the
+        blocking nodes, so a service can fail fast at construction instead of
+        discovering per-call degradation under traffic. ``strict=False`` is
+        the escape hatch for callers that probe-and-fall-back: returns None.
+
+        Every XLA *trace* of the compiled function (one per distinct input
+        shape/dtype — i.e. one per compile actually paid) appends the input's
+        ``(shape, dtype)`` signature to :attr:`compiled_signatures` and fires
+        ``on_trace(signature)`` — the hook callers use to count compiles and
+        assert shape-stability invariants. (The serving engine keeps its own
+        private jit with equivalent per-trace accounting so that direct use
+        of this method cannot pollute a live engine's counters.)
+        """
         import jax
 
         fn = self.trace_fn()
         if fn is None:
-            raise ValueError("pipeline contains untraceable nodes; cannot compile")
-        self._compiled = jax.jit(fn)
+            if strict:
+                raise NotTraceableError(self.untraceable_nodes())
+            return None
+        # counts are per-live-jit (same contract __getstate__ enforces):
+        # a recompile replaces the executable, so stale signatures from the
+        # discarded jit would report phantom recompiles
+        self._compiled_signatures = []
+        signatures = self._compiled_signatures
+
+        def traced(x):
+            # runs only while jax traces, i.e. exactly once per compile;
+            # bound to THIS jit's list so a superseded executable that
+            # retraces can't pollute the replacement's accounting
+            sig = (tuple(x.shape), str(x.dtype))
+            signatures.append(sig)
+            if on_trace is not None:
+                on_trace(sig)
+            return fn(x)
+
+        self._compiled = jax.jit(traced)
         return self._compiled
+
+    @property
+    def compiled_signatures(self) -> List[tuple]:
+        """``(shape, dtype)`` of every trace paid so far, in compile order."""
+        return list(self._compiled_signatures)
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._compiled_signatures)
 
     def apply_compiled(self, data: Any) -> Any:
         if self._compiled is None:
@@ -435,14 +532,13 @@ class FittedPipeline(Chainable):
         """
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
-        for node in self._graph.nodes:
-            op = self._graph.get_operator(node)
-            if getattr(op, "batch_coupled", False):
-                raise ValueError(
-                    f"apply_chunked on a batch-coupled chain ({op.label}): "
-                    "the padded tail chunk would corrupt batch statistics — "
-                    "use apply() instead"
-                )
+        coupled = self.batch_coupled_nodes()
+        if coupled:
+            raise ValueError(
+                f"apply_chunked on a batch-coupled chain ({coupled[0]}): "
+                "the padded tail chunk would corrupt batch statistics — "
+                "use apply() instead"
+            )
         if self._compiled is None:
             self.compile()
         arr = Dataset.of(data).to_array() if not hasattr(data, "shape") else data
@@ -520,4 +616,10 @@ class FittedPipeline(Chainable):
     def __getstate__(self):
         state = dict(self.__dict__)
         state["_compiled"] = None  # jitted callables don't pickle
+        state["_compiled_signatures"] = []  # counts are per-live-jit
         return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        # pickles from before compile-signature tracking
+        self.__dict__.setdefault("_compiled_signatures", [])

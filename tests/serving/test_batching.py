@@ -1,0 +1,69 @@
+"""BucketPolicy: bucket selection, padding, validation, warm-up inputs."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.serving.batching import BucketPolicy
+from keystone_tpu.serving.errors import InvalidRequest
+
+
+def test_bucket_for_picks_smallest_fitting():
+    p = BucketPolicy(batch_sizes=(32, 1, 8))  # unsorted on purpose
+    assert p.batch_sizes == (1, 8, 32)
+    assert p.bucket_for(1) == 1
+    assert p.bucket_for(2) == 8
+    assert p.bucket_for(8) == 8
+    assert p.bucket_for(9) == 32
+    assert p.max_size == 32
+    with pytest.raises(ValueError):
+        p.bucket_for(33)
+    with pytest.raises(ValueError):
+        p.bucket_for(0)
+
+
+def test_invalid_bucket_sizes_rejected():
+    with pytest.raises(ValueError):
+        BucketPolicy(batch_sizes=())
+    with pytest.raises(ValueError):
+        BucketPolicy(batch_sizes=(0, 4))
+
+
+def test_pad_repeats_first_row():
+    p = BucketPolicy(batch_sizes=(4,), datum_shape=(2,))
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    padded = p.pad(x, 4)
+    assert padded.shape == (4, 2)
+    np.testing.assert_array_equal(padded[2], x[0])
+    np.testing.assert_array_equal(padded[3], x[0])
+    # already-full batches pass through untouched
+    assert p.pad(padded, 4) is padded
+    with pytest.raises(ValueError):
+        p.pad(padded, 2)
+
+
+def test_validate_enforces_configured_shape():
+    p = BucketPolicy(datum_shape=(3,))
+    out = p.validate([1, 2, 3])
+    assert out.dtype == np.float32 and out.shape == (3,)
+    with pytest.raises(InvalidRequest):
+        p.validate([1, 2])
+    with pytest.raises(InvalidRequest):
+        p.validate("not a number")
+
+
+def test_validate_locks_shape_from_first_datum():
+    p = BucketPolicy()
+    assert p.datum_shape is None
+    p.validate(np.zeros((5,)))
+    assert p.datum_shape == (5,)
+    with pytest.raises(InvalidRequest):
+        p.validate(np.zeros((6,)))
+
+
+def test_warmup_inputs_cover_every_bucket():
+    p = BucketPolicy(batch_sizes=(2, 4), datum_shape=(3,), dtype=np.float32)
+    inputs = list(p.warmup_inputs())
+    assert [x.shape for x in inputs] == [(2, 3), (4, 3)]
+    assert all(x.dtype == np.float32 for x in inputs)
+    with pytest.raises(ValueError):
+        list(BucketPolicy().warmup_inputs())

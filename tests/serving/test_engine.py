@@ -1,0 +1,313 @@
+"""ServingEngine: lifecycle, backpressure, deadlines, error isolation, and
+the acceptance scenario — 64+ concurrent requests through a fitted
+MNIST-style pipeline on the 8-device virtual CPU mesh with exactly one
+compile per bucket and responses matching direct application."""
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from keystone_tpu.serving import (
+    DeadlineExceeded,
+    EngineClosed,
+    InvalidRequest,
+    QueueFull,
+    ServingEngine,
+)
+from keystone_tpu.workflow.pipeline import NotTraceableError
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def _toy_fitted():
+    """A cheap transformer-only chain (row-wise, traceable)."""
+    return (
+        FunctionNode(batch_fn=lambda X: X * 2.0, label="double")
+        >> FunctionNode(batch_fn=lambda X: X.sum(axis=1), label="rowsum")
+    ).fit()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle / admission
+# ---------------------------------------------------------------------------
+
+
+def test_predict_before_start_raises_instead_of_hanging():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    with pytest.raises(RuntimeError):
+        engine.predict(np.ones(2))
+    # submit() still buffers pre-start; the future resolves once started
+    fut = engine.submit(np.ones(2))
+    engine.start()
+    assert abs(fut.result(timeout=30) - 4.0) < 1e-6
+    engine.shutdown()
+
+
+def test_batch_coupled_chain_rejected_at_construction():
+    fitted = FunctionNode(
+        batch_fn=lambda X: X - X.mean(axis=0), label="batchmean"
+    ).to_pipeline().fit()
+    for node in fitted.graph.nodes:
+        # mark the chain the way whole-batch-statistics transformers do
+        fitted.graph.get_operator(node).batch_coupled = True
+    with pytest.raises(ValueError, match="batch-coupled"):
+        ServingEngine(fitted, datum_shape=(2,))
+
+
+def test_engine_jit_is_private_to_the_engine():
+    """Construction must not hijack the pipeline's own compiled state —
+    a later fitted.compile()/apply_compiled cannot pollute the engine's
+    compile accounting, nor discard its warm cache."""
+    fitted = _toy_fitted()
+    engine = ServingEngine(fitted, buckets=(4,), datum_shape=(2,))
+    engine.start()
+    assert engine.metrics.count("compiles") == 1
+    # direct pipeline use traces its own jit; engine accounting unmoved
+    fitted.compile()(np.zeros((7, 2), np.float32))
+    assert fitted.compile_count == 1
+    assert engine.metrics.count("compiles") == 1
+    assert abs(engine.predict(np.ones(2), timeout=30.0) - 4.0) < 1e-6
+    assert engine.metrics.count("compiles") == 1
+    engine.shutdown()
+
+
+def test_concurrent_shutdown_is_safe():
+    import threading
+
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    engine.start()
+    errors = []
+
+    def close():
+        try:
+            engine.shutdown(drain=True)
+        except Exception as e:  # pragma: no cover - the regression itself
+            errors.append(e)
+
+    threads = [threading.Thread(target=close) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+
+def test_unbounded_queue_config_rejected():
+    with pytest.raises(ValueError, match="max_queue"):
+        ServingEngine(_toy_fitted(), datum_shape=(2,), max_queue=0)
+
+
+def test_worker_survives_failing_gauge():
+    engine = ServingEngine(
+        _toy_fitted(), buckets=(4,), datum_shape=(2,), log_interval_s=0.0
+    )
+
+    def bad_gauge():
+        raise RuntimeError("gauge exploded")
+
+    engine.metrics.set_gauge("bad", bad_gauge)
+    engine.start()
+    # maybe_log fires after every batch (interval 0) and its snapshot hits
+    # the raising gauge; the worker must keep serving regardless
+    assert abs(engine.predict(np.ones(2), timeout=30.0) - 4.0) < 1e-6
+    assert abs(engine.predict(np.ones(2), timeout=30.0) - 4.0) < 1e-6
+    engine.shutdown()
+
+
+def test_construction_fails_fast_on_untraceable_pipeline():
+    fitted = FunctionNode(item_fn=lambda x: x, label="host_only").to_pipeline().fit()
+    with pytest.raises(NotTraceableError) as exc:
+        ServingEngine(fitted, datum_shape=(2,))
+    assert "host_only" in exc.value.labels
+
+
+def test_queue_full_rejects_instead_of_growing():
+    engine = ServingEngine(
+        _toy_fitted(), buckets=(4,), datum_shape=(2,), max_queue=4
+    )
+    # worker not started: the queue fills and the 5th submit is shed
+    futs = [engine.submit(np.ones(2)) for _ in range(4)]
+    with pytest.raises(QueueFull):
+        engine.submit(np.ones(2))
+    assert engine.metrics.count("rejected") == 1
+    # once the worker runs, the queued four complete normally
+    engine.start()
+    assert all(abs(f.result(timeout=30) - 4.0) < 1e-6 for f in futs)
+    engine.shutdown()
+    assert engine.metrics.count("completed") == 4
+
+
+def test_submit_after_drain_raises_engine_closed():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    engine.start()
+    engine.shutdown(drain=True)
+    with pytest.raises(EngineClosed):
+        engine.submit(np.ones(2))
+
+
+def test_shutdown_without_start_rejects_queued_requests():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    fut = engine.submit(np.ones(2))
+    engine.shutdown()  # must not hang waiting on a worker that never ran
+    with pytest.raises(EngineClosed):
+        fut.result(timeout=5)
+
+
+def test_request_landing_during_shutdown_is_not_stranded():
+    """A submit that slips its put past shutdown's drain (TOCTOU on the
+    _closed check) must still reach a terminal state via the post-join
+    queue sweep."""
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    engine.start()
+    engine.shutdown(drain=True)
+    # simulate the race: the request is enqueued after the worker exited
+    import queue as _queue
+    from keystone_tpu.serving.engine import _Request
+
+    late = _Request(datum=np.ones(2), deadline=None, enqueued=time.monotonic())
+    try:
+        engine._queue.put_nowait(late)
+    except _queue.Full:
+        pytest.skip("queue unexpectedly full")
+    engine.shutdown()  # idempotent; runs the sweep that catches racing puts
+    with pytest.raises(EngineClosed):
+        late.future.result(timeout=5)
+
+
+def test_abortive_shutdown_fails_queued_requests():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    futs = [engine.submit(np.ones(2)) for _ in range(3)]
+    engine.start()
+    engine.shutdown(drain=False)
+    # every fate is terminal: a result that landed before the abort, or
+    # a typed EngineClosed — never a hang
+    for f in futs:
+        try:
+            f.result(timeout=30)
+        except EngineClosed:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# deadlines / error isolation
+# ---------------------------------------------------------------------------
+
+
+def test_expired_deadline_surfaces_typed_error_without_stalling():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    # enqueue with a deadline that expires before the worker exists
+    doomed = engine.submit(np.ones(2), timeout=0.001)
+    time.sleep(0.05)
+    engine.start()
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=30)
+    # the worker loop survived: later traffic is served
+    assert abs(engine.predict(np.ones(2), timeout=30.0) - 4.0) < 1e-6
+    engine.shutdown()
+    assert engine.metrics.count("expired") == 1
+
+
+def test_invalid_datum_isolated_from_rest_of_batch():
+    engine = ServingEngine(_toy_fitted(), buckets=(8,), datum_shape=(2,))
+    good = [engine.submit(np.full(2, float(i))) for i in range(3)]
+    bad = engine.submit(np.ones(5))  # wrong shape, same micro-batch
+    engine.start()
+    with pytest.raises(InvalidRequest):
+        bad.result(timeout=30)
+    for i, f in enumerate(good):
+        assert abs(f.result(timeout=30) - 4.0 * i) < 1e-6
+    engine.shutdown()
+    assert engine.metrics.count("invalid") == 1
+    assert engine.metrics.count("completed") == 3
+
+
+# ---------------------------------------------------------------------------
+# acceptance: concurrent traffic over a fitted MNIST-style pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mnist_fitted():
+    from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        NUM_CLASSES,
+        MnistRandomFFTConfig,
+        build_featurizer,
+        synthetic_mnist_device,
+    )
+
+    conf = MnistRandomFFTConfig(num_ffts=2, block_size=512, lam=100.0)
+    train, test = synthetic_mnist_device(n_train=2048, n_test=128)
+    labels = ClassLabelIndicators(NUM_CLASSES).apply_batch(train.labels)
+    fitted = (
+        build_featurizer(conf)
+        .and_then(
+            BlockLeastSquaresEstimator(conf.block_size, 1, conf.lam or 0.0),
+            train.data,
+            labels,
+        )
+        .and_then(MaxClassifier())
+        .fit()
+    )
+    return fitted, np.asarray(test.data.to_array())
+
+
+def test_64_concurrent_requests_one_compile_per_bucket(mnist_fitted):
+    import jax
+
+    assert len(jax.devices()) == 8  # the virtual mesh the suite provisions
+    from keystone_tpu.utils import timing
+
+    fitted, data = mnist_fitted
+    data = data[:64]
+    buckets = (8, 32)
+    batches_before = (
+        timing.snapshot(prefix="serve.").get("serve.batch", {}).get("calls", 0)
+    )
+    engine = ServingEngine(
+        fitted,
+        buckets=buckets,
+        datum_shape=data.shape[1:],
+        max_queue=256,
+        max_wait_ms=2.0,
+    )
+    with engine:
+        # warm-up paid exactly one compile per configured bucket
+        assert engine.metrics.count("compiles") == len(buckets)
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            preds = list(
+                pool.map(lambda row: engine.predict(row, timeout=60.0), data)
+            )
+        # steady state: ZERO additional compiles under 64 concurrent requests
+        assert engine.metrics.count("compiles") == len(buckets)
+        snap = engine.metrics.snapshot()
+
+    # responses match whole-batch application...
+    expected = np.asarray(fitted.apply(data).to_array())
+    np.testing.assert_array_equal(np.asarray(preds).ravel(), expected.ravel())
+    # ...and single-datum apply results
+    for i in range(0, 64, 16):
+        assert int(preds[i]) == int(np.asarray(fitted.apply_datum(data[i])))
+
+    # metrics snapshot is internally consistent
+    c = snap["counters"]
+    assert c["submitted"] == 64
+    assert c["completed"] == 64
+    assert c.get("rejected", 0) == 0 and c.get("expired", 0) == 0
+    assert snap["gauges"]["queue_depth"] == 0
+    occ = snap["batch_occupancy"]
+    assert occ["items"] == 64
+    assert occ["capacity"] >= 64
+    assert snap["latency"]["count"] == 64
+    assert snap["latency"]["p50"] <= snap["latency"]["p99"]
+    assert c["batches"] >= 2  # 64 requests cannot fit one 32-row bucket
+    assert "serve.batch" in snap["phases"]
+    # the phase registry is process-global; compare against this test's delta
+    assert snap["phases"]["serve.batch"]["calls"] - batches_before == c["batches"]
+    # the engine's private jit saw exactly the bucket shapes, nothing else
+    assert len(engine.compiled_signatures) == len(buckets)
+    assert {sig[0][0] for sig in engine.compiled_signatures} == set(buckets)
+    # and the shared pipeline's own compiled state was never touched
+    assert fitted.compile_count == 0

@@ -1,0 +1,75 @@
+"""MetricsRegistry + the obs/timing exports it rides on."""
+
+import numpy as np
+
+from keystone_tpu.serving.metrics import MetricsRegistry
+from keystone_tpu.utils import timing
+from keystone_tpu.utils.obs import every
+
+
+def test_counters_and_gauges():
+    m = MetricsRegistry("t")
+    m.inc("submitted")
+    m.inc("submitted", 3)
+    m.set_gauge("queue_depth", lambda: 7)
+    assert m.count("submitted") == 4
+    snap = m.snapshot()
+    assert snap["counters"]["submitted"] == 4
+    assert snap["gauges"]["queue_depth"] == 7
+
+
+def test_latency_quantiles_on_known_data():
+    m = MetricsRegistry("t")
+    for v in np.linspace(0.001, 0.1, 100):
+        m.observe_latency(float(v))
+    q = m.latency_quantiles()
+    assert q["count"] == 100
+    assert q["p50"] <= q["p95"] <= q["p99"]
+    assert abs(q["p50"] - 0.0505) < 0.01
+    # nearest-rank, not one-past: p99 of 100 samples is the 99th value,
+    # NOT the maximum
+    vals = sorted(np.linspace(0.001, 0.1, 100))
+    assert q["p99"] == float(vals[98])
+    assert q["p50"] == float(vals[49])
+    # empty registry reports a bare count
+    assert MetricsRegistry("e").latency_quantiles() == {"count": 0}
+
+
+def test_batch_occupancy_ratio():
+    m = MetricsRegistry("t")
+    m.observe_batch(6, 8)
+    m.observe_batch(2, 8)
+    snap = m.snapshot()["batch_occupancy"]
+    assert snap["items"] == 8 and snap["capacity"] == 16
+    assert abs(snap["ratio"] - 0.5) < 1e-9
+    assert m.count("batches") == 2
+
+
+def test_snapshot_embeds_serve_phase_stats():
+    timing.reset()
+    timing.record("serve.batch", 0.25)
+    timing.record("krr.local_solve", 1.0)  # another subsystem's phase
+    try:
+        phases = MetricsRegistry("t").snapshot()["phases"]
+        assert phases == {"serve.batch": {"seconds": 0.25, "calls": 1}}
+        # the unfiltered view still carries everything
+        assert "krr.local_solve" in timing.snapshot()
+    finally:
+        timing.reset()
+
+
+def test_obs_every_rate_limits():
+    key = "test-every-unique-key"
+    assert every(key, 60.0) is True
+    assert every(key, 60.0) is False
+    assert every(key, 0.0) is True  # window elapsed
+
+
+def test_maybe_log_is_rate_limited(caplog):
+    import logging
+
+    m = MetricsRegistry("rate-limit-test")
+    with caplog.at_level(logging.INFO, logger="keystone_tpu.serving.metrics"):
+        assert m.maybe_log(60.0) is True
+        assert m.maybe_log(60.0) is False
+    assert len(caplog.records) == 1

@@ -1,0 +1,121 @@
+"""Recompile accounting on FittedPipeline.compile: exactly one XLA trace
+per distinct (bucketed) input shape, and an unbucketed shape change is a
+counted recompile — the invariant the serving bucket policy protects."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow.pipeline import NotTraceableError
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def _double(X):
+    return X * 2.0
+
+
+def _inc(X):
+    return X + 1.0
+
+
+def _fitted():
+    # module-level batch fns (not lambdas) so the pickle round-trip test works
+    return (
+        FunctionNode(batch_fn=_double, label="double")
+        >> FunctionNode(batch_fn=_inc, label="inc")
+    ).fit()
+
+
+def test_compiles_once_per_shape_and_counts_recompiles():
+    fitted = _fitted()
+    traces = []
+    fn = fitted.compile(on_trace=traces.append)
+
+    fn(np.zeros((8, 4), np.float32))
+    fn(np.ones((8, 4), np.float32))  # same shape: cache hit, no trace
+    assert fitted.compile_count == 1
+    assert traces == [((8, 4), "float32")]
+
+    fn(np.zeros((32, 4), np.float32))  # second bucket: one more compile
+    assert fitted.compile_count == 2
+
+    fn(np.zeros((8, 4), np.float32))  # steady state: still 2
+    fn(np.zeros((32, 4), np.float32))
+    assert fitted.compile_count == 2
+
+    # an unbucketed shape change triggers — and is counted as — a recompile
+    fn(np.zeros((13, 4), np.float32))
+    assert fitted.compile_count == 3
+    assert fitted.compiled_signatures[-1] == ((13, 4), "float32")
+    assert traces == fitted.compiled_signatures
+
+
+def test_compiled_matches_uncompiled_apply():
+    fitted = _fitted()
+    fn = fitted.compile()
+    x = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(fn(x)),
+        np.asarray(fitted.apply(x).to_array()),
+        rtol=1e-6,
+    )
+
+
+def test_not_traceable_error_survives_pickle():
+    import pickle
+
+    from keystone_tpu.workflow.pipeline import NotTraceableError as NTE
+
+    err = pickle.loads(pickle.dumps(NTE(["nodeA", "nodeB"])))
+    assert err.labels == ["nodeA", "nodeB"]
+    assert "nodeA" in str(err)
+
+
+def test_untraceable_pipeline_raises_typed_error():
+    fitted = (
+        FunctionNode(batch_fn=lambda X: X * 2.0, label="double")
+        >> FunctionNode(item_fn=lambda x: x, label="host_only")
+    ).fit()
+    assert not fitted.is_traceable
+    assert "host_only" in fitted.untraceable_nodes()
+    with pytest.raises(NotTraceableError) as exc:
+        fitted.compile()
+    assert "host_only" in str(exc.value)
+    assert "host_only" in exc.value.labels
+    # NotTraceableError stays catchable as the ValueError it used to be
+    with pytest.raises(ValueError):
+        fitted.compile()
+    # the escape hatch degrades to None instead of raising
+    assert fitted.compile(strict=False) is None
+
+
+def test_recompile_resets_signature_accounting():
+    """compile() replaces the executable, so counts restart per live jit —
+    a second engine over the same fitted pipeline must not see phantom
+    recompiles from the first."""
+    fitted = _fitted()
+    fn1 = fitted.compile()
+    fn1(np.zeros((8, 4), np.float32))
+    fn1(np.zeros((16, 4), np.float32))
+    assert fitted.compile_count == 2
+    fn2 = fitted.compile()
+    assert fitted.compile_count == 0
+    fn2(np.zeros((8, 4), np.float32))
+    assert fitted.compile_count == 1
+    # a retrace on the superseded jit doesn't pollute the live accounting
+    fn1(np.zeros((32, 4), np.float32))
+    assert fitted.compile_count == 1
+
+
+def test_signatures_reset_across_pickle(tmp_path):
+    fitted = _fitted()
+    fn = fitted.compile()
+    fn(np.zeros((4, 2), np.float32))
+    assert fitted.compile_count == 1
+    path = str(tmp_path / "p.pkl")
+    fitted.save(path)
+    from keystone_tpu.workflow.pipeline import FittedPipeline
+
+    loaded = FittedPipeline.load(path)
+    assert loaded.compile_count == 0  # counts are per-live-jit
+    loaded.compile()(np.zeros((4, 2), np.float32))
+    assert loaded.compile_count == 1
