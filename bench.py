@@ -1872,6 +1872,13 @@ def _section(name, fn):
 
 
 def main() -> int:
+    # KEYSTONE_TRACE=path opts into pipeline tracing: per-node spans are
+    # collected across every section and the summary lands in the JSON
+    # under "trace". Opt-in because each traced node pays a device sync —
+    # accurate attribution, but NOT the headline-timing configuration.
+    from keystone_tpu.utils.obs import configure
+
+    configure()
     mnist = _section("mnist", bench_mnist)
     solvers = _section("solvers", bench_solvers)
     krr = _section("krr", bench_krr)
@@ -1879,6 +1886,21 @@ def main() -> int:
     text = _section("text", bench_text)
     voc = _section("voc", bench_voc_real_codebook)
     weak_scaling = _section("weak_scaling", bench_weak_scaling)
+    from keystone_tpu.obs import tracer as trace_mod
+
+    tracer = trace_mod.current()
+    trace_extra = (
+        {
+            "path": trace_mod.export(),
+            "span_summary": tracer.span_summary(),
+            "note": (
+                "tracing adds a device sync per DAG-node span — headline "
+                "timings in a traced run carry that overhead"
+            ),
+        }
+        if tracer is not None
+        else None
+    )
     print(
         json.dumps(
             {
@@ -1902,6 +1924,7 @@ def main() -> int:
                     "text_featurization": text,
                     "voc_real_codebook": voc,
                     "weak_scaling_virtual_mesh": weak_scaling,
+                    "trace": trace_extra,
                 },
             }
         )

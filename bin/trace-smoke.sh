@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Smoke-test pipeline tracing end-to-end: run the MNIST pipeline on CPU
+# at a tier-1-fast config with --trace, then validate the output is
+# well-formed Chrome-trace JSON — non-empty traceEvents, monotonic ts,
+# and at least one cache-annotated DAG-node span. Exits non-zero on any
+# failure. Extra flags pass through to the pipeline, e.g.:
+#   bin/trace-smoke.sh /tmp/trace.json --numFFTs 4
+set -euo pipefail
+cd "$(dirname "$0")/.."
+out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
+[ $# -gt 0 ] && shift
+env JAX_PLATFORMS=cpu python -m keystone_tpu mnist --backend cpu \
+  --numFFTs 2 --blockSize 512 --lambda 100 --trace "$out" "$@"
+python - "$out" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    doc = json.load(f)
+events = doc.get("traceEvents")
+assert isinstance(events, list) and events, "empty or missing traceEvents"
+ts = [e["ts"] for e in events]
+assert all(b >= a for a, b in zip(ts, ts[1:])), "non-monotonic ts"
+assert any(
+    e.get("args", {}).get("cache") for e in events
+), "no cache-annotated DAG-node spans"
+print(f"TRACE OK: {len(events)} events -> {sys.argv[1]}")
+PY

@@ -79,6 +79,18 @@ def _stupid_backoff(argv):
     return main(argv)
 
 
+#: shorthand → reference application object name (the full names stay the
+#: canonical registry keys; these are CLI conveniences only)
+ALIASES = {
+    "mnist": "MnistRandomFFT",
+    "cifar": "RandomPatchCifar",
+    "voc": "VOCSIFTFisher",
+    "imagenet": "ImageNetSiftLcsFV",
+    "timit": "TimitPipeline",
+    "newsgroups": "NewsgroupsPipeline",
+    "amazon": "AmazonReviewsPipeline",
+}
+
 #: reference application object name → runner
 PIPELINES = {
     "MnistRandomFFT": _mnist,
@@ -143,7 +155,14 @@ def main(argv: Optional[List[str]] = None) -> int:
              "replaces the pipeline name",
     )
     if not serve_demo:
-        p.add_argument("pipeline", choices=sorted(PIPELINES))
+        # validated by _resolve_pipeline, not choices=, so shorthand
+        # aliases (mnist, cifar, ...) and any-case names resolve
+        p.add_argument(
+            "pipeline", metavar="pipeline",
+            help="one of: " + ", ".join(sorted(PIPELINES))
+                 + " (case-insensitive; shorthands: "
+                 + ", ".join(sorted(ALIASES)) + ")",
+        )
     p.add_argument(
         "--backend", choices=["tpu", "cpu"], default=None,
         help="jax platform; default = whatever jax picks",
@@ -162,16 +181,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="per-phase device-time logs in the hot solvers "
              "(also: KEYSTONE_PROFILE=1)",
     )
+    p.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="record a per-node execution trace and write Chrome-trace "
+             "JSON to PATH — open in chrome://tracing or "
+             "https://ui.perfetto.dev (also: KEYSTONE_TRACE=PATH)",
+    )
     args, rest = p.parse_known_args(argv)
-    from .utils.obs import configure
+    if not serve_demo:
+        name = _resolve_pipeline(p, args.pipeline)
+    from .utils.obs import configure, export_trace
 
-    configure(args.log_level, profile=args.profile or None)
+    configure(args.log_level, profile=args.profile or None, trace=args.trace)
     _select_backend(args.backend, args.cpuDevices)
-    if serve_demo:
-        from .serving.demo import main as serve_demo_main
+    try:
+        if serve_demo:
+            from .serving.demo import main as serve_demo_main
 
-        return serve_demo_main(rest)
-    return PIPELINES[args.pipeline](rest)
+            return serve_demo_main(rest)
+        return PIPELINES[name](rest)
+    finally:
+        # no-op unless --trace/KEYSTONE_TRACE configured tracing; writing
+        # here (not only atexit) means in-process callers get the file too
+        export_trace()
+
+
+def _resolve_pipeline(parser: argparse.ArgumentParser, name: str) -> str:
+    if name in PIPELINES:
+        return name
+    lowered = {k.lower(): k for k in PIPELINES}
+    full = ALIASES.get(name.lower()) or lowered.get(name.lower())
+    if full is None:
+        parser.error(
+            f"argument pipeline: invalid choice: {name!r} "
+            f"(choose from {', '.join(sorted(PIPELINES))})"
+        )
+    return full
 
 
 if __name__ == "__main__":

@@ -1,0 +1,35 @@
+"""Pipeline tracing & per-node profiling.
+
+The observability subsystem the source paper's optimizer implies but
+never ships: a :class:`~keystone_tpu.obs.tracer.Tracer` collecting a span
+tree across the three execution layers (graph executor pulls, autocache
+planning, serving micro-batches), Chrome-trace/Perfetto export, a
+plain-text top-N summary, and the estimate-vs-observed autocache audit.
+
+Enable with ``KEYSTONE_TRACE=/path/trace.json`` (or the CLI's
+``--trace PATH``); disabled, every instrumentation point is a single
+``current() is None`` check.
+"""
+
+from .audit import cache_audit, log_cache_audit
+from .export import format_top_spans, to_chrome_trace, write_chrome_trace
+from .span import Span, cheap_nbytes
+from .tracer import Tracer, current, export, install, reset, start, stop, suspended
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "cache_audit",
+    "cheap_nbytes",
+    "current",
+    "export",
+    "format_top_spans",
+    "install",
+    "log_cache_audit",
+    "reset",
+    "start",
+    "stop",
+    "suspended",
+    "to_chrome_trace",
+    "write_chrome_trace",
+]

@@ -1,0 +1,370 @@
+"""The tracer: thread-safe span collection with a process-global switch.
+
+Parity motivation: KeystoneML's optimizer is profile-guided but its
+EXECUTION is blind — per-stage attribution lives in the Spark UI, outside
+the system. Here the :class:`Tracer` is that attribution layer: every DAG
+node pull, autocache decision, and serving micro-batch lands in one span
+registry, exportable as Chrome-trace JSON (``obs/export.py``) and audited
+against the cache planner's estimates (``obs/audit.py``).
+
+Overhead contract: tracing is OFF unless a tracer is installed —
+:func:`current` returns None and every instrumentation site is a single
+``is None`` check with NO span allocation. Installed, each span costs one
+dataclass + two clock reads (+ an optional device sync at exit, which is
+the point: accurate attribution).
+
+Wiring: ``utils/obs.configure`` installs the global tracer from
+``KEYSTONE_TRACE=path`` (or the CLI's ``--trace PATH``) and registers an
+atexit export; library code only ever calls :func:`current`.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import itertools
+import logging
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from .span import Span, cheap_nbytes, sync_value
+
+logger = logging.getLogger(__name__)
+
+# -- XLA compile counting ---------------------------------------------------
+
+#: process-wide count of XLA backend compiles, fed by jax.monitoring.
+#: Listeners cannot be unregistered individually, so this installs once
+#: (lazily, on first Tracer construction) and stays for the process life;
+#: the increment is negligible and only spans read the counter.
+_compiles = itertools.count()
+_compiles_seen = 0
+_compile_listener_lock = threading.Lock()
+_compile_listener_installed = False
+
+
+def _compile_count() -> int:
+    return _compiles_seen
+
+
+def _install_compile_listener() -> None:
+    global _compile_listener_installed
+    with _compile_listener_lock:
+        if _compile_listener_installed:
+            return
+        _compile_listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            # one /jax/core/compile/backend_compile_duration per real
+            # XLA compile (cache hits emit cache events instead)
+            if event.endswith("backend_compile_duration"):
+                global _compiles_seen
+                _compiles_seen = next(_compiles) + 1
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        logger.debug("jax compile-event listener unavailable", exc_info=True)
+
+
+# -- the tracer -------------------------------------------------------------
+
+
+class Tracer:
+    """Collects a span tree per thread; thread-safe for concurrent writers
+    (the serving worker and N pipeline threads trace into one registry)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self.epoch = time.perf_counter()
+        self.epoch_unix = time.time()
+        #: node_id -> estimate row recorded by the autocache planner
+        #: (see obs/audit.py for the estimate-vs-observed feedback loop)
+        self._estimates: Dict[str, dict] = {}
+        _install_compile_listener()
+
+    # -- span recording -------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        *,
+        node_id: Optional[str] = None,
+        op_type: Optional[str] = None,
+        cache: Optional[str] = None,
+        **attrs,
+    ) -> Iterator[Span]:
+        """Open a span; the yielded handle takes extra attrs and an
+        optional ``sync_on(value)`` target blocked on at exit."""
+        stack = self._stack()
+        thread = threading.current_thread()
+        sp = Span(
+            name=name,
+            start=time.perf_counter(),
+            span_id=next(self._ids),
+            parent_id=stack[-1].span_id if stack else None,
+            depth=len(stack),
+            tid=thread.ident or 0,
+            thread_name=thread.name,
+            node_id=node_id,
+            op_type=op_type,
+            cache=cache,
+            attrs=dict(attrs),
+        )
+        compiles_at_start = _compile_count()
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            stack.pop()
+            target = sp.sync_target
+            if target is not None:
+                sp.sync_target = None
+                t0 = time.perf_counter()
+                if sync_value(target):
+                    sp.sync_seconds = time.perf_counter() - t0
+                if sp.output_bytes is None:
+                    sp.output_bytes = cheap_nbytes(target)
+            sp.end = time.perf_counter()
+            sp.compiles = _compile_count() - compiles_at_start
+            with self._lock:
+                self._spans.append(sp)
+
+    def instant(
+        self,
+        name: str,
+        *,
+        node_id: Optional[str] = None,
+        op_type: Optional[str] = None,
+        cache: Optional[str] = None,
+        **attrs,
+    ) -> Span:
+        """A zero-duration event (e.g. a memo-cache hit)."""
+        stack = self._stack()
+        thread = threading.current_thread()
+        now = time.perf_counter()
+        sp = Span(
+            name=name,
+            start=now,
+            end=now,
+            span_id=next(self._ids),
+            parent_id=stack[-1].span_id if stack else None,
+            depth=len(stack),
+            tid=thread.ident or 0,
+            thread_name=thread.name,
+            node_id=node_id,
+            op_type=op_type,
+            cache=cache,
+            instant=True,
+            attrs=dict(attrs),
+        )
+        with self._lock:
+            self._spans.append(sp)
+        return sp
+
+    def record_complete(self, sp: Span) -> None:
+        """Append an externally-built, already-finished span (used by the
+        executor for eagerly-computed expressions). Fills in identity and
+        tree position from the calling thread's open span, if any."""
+        stack = self._stack()
+        thread = threading.current_thread()
+        sp.span_id = next(self._ids)
+        if sp.parent_id is None and stack:
+            sp.parent_id = stack[-1].span_id
+            sp.depth = len(stack)
+        sp.tid = thread.ident or 0
+        sp.thread_name = thread.name
+        with self._lock:
+            self._spans.append(sp)
+
+    # -- reads ----------------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def span_summary(
+        self, prefix: Optional[str] = None
+    ) -> Dict[str, Dict[str, object]]:
+        """``{name: {"seconds", "calls", ...}}`` — the SAME shape as
+        ``utils.timing.snapshot`` and ``MetricsRegistry.snapshot()["phases"]``
+        so span, phase, and metrics exports concatenate without schema
+        mismatches. ``prefix`` filters to one subsystem (e.g. ``"serve."``)."""
+        agg: Dict[str, dict] = {}
+        for sp in self.spans():
+            if prefix is not None and not sp.name.startswith(prefix):
+                continue
+            row = agg.setdefault(
+                sp.name,
+                {
+                    "seconds": 0.0,
+                    "calls": 0,
+                    "sync_seconds": 0.0,
+                    "bytes": 0,
+                    "compiles": 0,
+                    "cache_hits": 0,
+                    "cache_misses": 0,
+                },
+            )
+            row["calls"] += 1
+            if sp.cache == "hit":
+                row["cache_hits"] += 1
+                continue
+            if sp.cache == "miss":
+                row["cache_misses"] += 1
+            row["seconds"] += sp.seconds
+            row["sync_seconds"] += sp.sync_seconds
+            row["compiles"] += sp.compiles
+            if sp.output_bytes:
+                row["bytes"] = max(row["bytes"], sp.output_bytes)
+        for row in agg.values():
+            row["seconds"] = round(row["seconds"], 4)
+            row["sync_seconds"] = round(row["sync_seconds"], 4)
+        return dict(sorted(agg.items()))
+
+    # -- autocache estimates (see obs/audit.py) -------------------------
+
+    def record_node_estimate(
+        self,
+        node_id: str,
+        label: str,
+        est_seconds: Optional[float] = None,
+        est_bytes: Optional[float] = None,
+        cacher: bool = False,
+    ) -> None:
+        with self._lock:
+            self._estimates[str(node_id)] = {
+                "label": label,
+                "est_seconds": est_seconds,
+                "est_bytes": est_bytes,
+                "cacher": bool(cacher),
+            }
+
+    @property
+    def estimates(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._estimates)
+
+
+# -- process-global wiring --------------------------------------------------
+
+_current: Optional[Tracer] = None
+_export_path: Optional[str] = None
+_atexit_registered = False
+#: spans already written by an explicit export — lets the atexit backstop
+#: skip the rewrite (and the duplicate summary/audit logs) when nothing
+#: new was recorded since
+_exported_span_count: Optional[int] = None
+_suspend = threading.local()
+
+
+def current() -> Optional[Tracer]:
+    """The installed tracer, or None (tracing disabled — the fast path).
+    Thread-locally None inside a :func:`suspended` block."""
+    if getattr(_suspend, "depth", 0):
+        return None
+    return _current
+
+
+def install(tracer: Tracer) -> Tracer:
+    global _current
+    _current = tracer
+    return tracer
+
+
+def start(path: Optional[str] = None) -> Tracer:
+    """Install a process tracer (idempotent: an existing tracer is kept so
+    repeated ``configure`` calls don't drop collected spans). ``path``
+    arms the atexit Chrome-trace export."""
+    global _current, _export_path, _atexit_registered
+    if _current is None:
+        _current = Tracer()
+    if path:
+        _export_path = path
+        if not _atexit_registered:
+            _atexit_registered = True
+            atexit.register(_atexit_export)
+    return _current
+
+
+def stop() -> Optional[Tracer]:
+    """Uninstall and return the tracer (spans stay readable on the
+    returned object)."""
+    global _current
+    tracer, _current = _current, None
+    return tracer
+
+
+def reset() -> None:
+    """Drop the installed tracer AND the export path (test hygiene)."""
+    global _current, _export_path, _exported_span_count
+    _current = None
+    _export_path = None
+    _exported_span_count = None
+
+
+@contextlib.contextmanager
+def suspended() -> Iterator[None]:
+    """Temporarily disable tracing ON THIS THREAD — used around the
+    autocache PROFILING runs so sampled-scale executions don't pollute
+    the real trace (their node ids would collide with the production
+    pull's). Thread-local so a serving worker tracing micro-batches is
+    unaffected by a concurrent fit's profiling window."""
+    _suspend.depth = getattr(_suspend, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _suspend.depth -= 1
+
+
+def _atexit_export() -> None:
+    """The exit backstop: write only if spans arrived since the last
+    explicit export — a CLI run that already exported in its ``finally``
+    must not rewrite the file and double-log the summary + audit."""
+    if _current is None:
+        return
+    if _exported_span_count == len(_current.spans()):
+        return
+    export()
+
+
+def export(path: Optional[str] = None) -> Optional[str]:
+    """Write the Chrome trace for the installed tracer to ``path`` (or the
+    path ``start`` armed), log the top-N span summary and the autocache
+    estimate-vs-observed audit. No-op (returns None) when tracing is off
+    or no path is configured. Safe under atexit: IO failures log a
+    warning instead of raising into interpreter shutdown."""
+    global _exported_span_count
+    tracer = _current
+    path = path or _export_path
+    if tracer is None or path is None:
+        return None
+    _exported_span_count = len(tracer.spans())
+    from .audit import log_cache_audit
+    from .export import format_top_spans, write_chrome_trace
+
+    try:
+        write_chrome_trace(tracer, path)
+    except OSError:
+        logger.warning("trace export to %s failed", path, exc_info=True)
+        return None
+    logger.info(
+        "trace: %d spans -> %s\n%s",
+        len(tracer.spans()),
+        path,
+        format_top_spans(tracer),
+    )
+    log_cache_audit(tracer)
+    return path

@@ -19,6 +19,7 @@ while the REST of its micro-batch completes — per-request error isolation.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import queue
 import threading
@@ -27,6 +28,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
+from ..obs.tracer import current as _trace_current
 from ..utils import timing
 from ..workflow.pipeline import FittedPipeline, NotTraceableError
 from .batching import BucketPolicy
@@ -373,9 +375,28 @@ class ServingEngine:
         bucket = self._policy.bucket_for(len(valid))
         padded = self._policy.pad(np.stack(rows), bucket)
         try:
-            with timing.phase("serve.batch") as hold:
-                out = self._compiled(padded)
-                hold.append(out)
+            # span name is "serve.microbatch" (not the phase's
+            # "serve.batch") so a merged {name: {seconds, calls, ...}}
+            # export of phases + spans never collides on keys
+            tracer = _trace_current()
+            with contextlib.ExitStack() as stack:
+                sp = (
+                    stack.enter_context(
+                        tracer.span(
+                            "serve.microbatch",
+                            op_type="ServingEngine",
+                            items=len(valid),
+                            bucket=bucket,
+                        )
+                    )
+                    if tracer is not None
+                    else None
+                )
+                with timing.phase("serve.batch") as hold:
+                    out = self._compiled(padded)
+                    hold.append(out)
+                if sp is not None:
+                    sp.sync_on(out)
             out = jax.device_get(out)  # one D2H fetch for the whole batch
         except Exception as e:  # batch-level failure → every member errors
             self._metrics.inc("batch_errors")

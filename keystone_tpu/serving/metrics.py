@@ -13,6 +13,12 @@ embedded in every snapshot under ``"phases"`` — the engine wraps its batch
 execution in ``timing.phase("serve.batch", ...)``, so under
 ``KEYSTONE_PROFILE=1`` the serving batches show up in the same per-phase
 device-time table as the solvers.
+
+Tracer spans (``keystone_tpu.obs``) land under ``"spans"`` in the SAME
+``{name: {"seconds", "calls", ...}}`` schema as ``"phases"`` — and the
+engine's span is named ``serve.microbatch`` vs the phase's
+``serve.batch`` — so bench/serve exports can concatenate the two dicts
+without key collisions or shape mismatches.
 """
 
 from __future__ import annotations
@@ -108,7 +114,22 @@ class MetricsRegistry:
             },
             "latency": self.latency_quantiles(),
             "phases": timing.snapshot(prefix="serve."),
+            "spans": self._span_summary(),
         }
+
+    @staticmethod
+    def _span_summary() -> Dict[str, object]:
+        """Serving spans from the installed tracer, ``{}`` when tracing is
+        off — same shape as ``"phases"`` (see module docstring). Like
+        ``"phases"``, this is PROCESS scope (the tracer registry is one
+        per process): with several engines live, it aggregates all of
+        them, whereas ``"counters"``/``"latency"`` are per-engine."""
+        from ..obs.tracer import current
+
+        tracer = current()
+        if tracer is None:
+            return {}
+        return tracer.span_summary(prefix="serve.")
 
     # -- periodic logging ----------------------------------------------
 

@@ -18,6 +18,9 @@ Environment switches (read by the CLI and by ``configure(None)``):
 
 * ``KEYSTONE_LOG=debug|info|warning|error`` — log level.
 * ``KEYSTONE_PROFILE=1`` — enable phase profiling + phase logs.
+* ``KEYSTONE_TRACE=/path/trace.json`` — install the pipeline tracer
+  (``keystone_tpu.obs``) and export a Chrome-trace/Perfetto JSON at
+  process exit (or explicitly via :func:`export_trace`).
 """
 
 from __future__ import annotations
@@ -51,15 +54,31 @@ def every(key: str, seconds: float) -> bool:
         return True
 
 
-def configure(level: Optional[str] = None, profile: Optional[bool] = None) -> None:
+def reset_rate_limits() -> None:
+    """Forget every :func:`every` key so the next call logs immediately.
+    ``timing.reset()`` calls this: a new measurement epoch must not
+    inherit the previous run's suppression windows (back-to-back bench
+    runs in one process were losing their first periodic summary)."""
+    with _every_lock:
+        _every_last.clear()
+
+
+def configure(
+    level: Optional[str] = None,
+    profile: Optional[bool] = None,
+    trace: Optional[str] = None,
+) -> None:
     """Configure logging (and optionally phase profiling) process-wide.
 
     ``level=None`` reads ``KEYSTONE_LOG`` (default: warning, stdlib's
     default visibility; unknown env values warn and fall back rather than
     crash the CLI). ``profile`` is the single profiling switch: True/False
     enable/disable phase syncs+logs, ``None`` follows ``KEYSTONE_PROFILE``
-    (off unless set to something truthy). Idempotent; later calls re-level
-    the root handler and re-apply the profiling switch.
+    (off unless set to something truthy). ``trace`` is a Chrome-trace
+    output path enabling the pipeline tracer (``keystone_tpu.obs``);
+    ``None`` follows ``KEYSTONE_TRACE`` (off unless set). Idempotent;
+    later calls re-level the root handler and re-apply the profiling
+    switch, and an already-installed tracer is kept (spans survive).
     """
     global _configured
     from_env = level is None
@@ -93,3 +112,20 @@ def configure(level: Optional[str] = None, profile: Optional[bool] = None) -> No
         # phase logs are INFO; make sure they are visible when profiling
         if lvl > logging.INFO:
             root.setLevel(logging.INFO)
+
+    if trace is None:
+        trace = os.environ.get("KEYSTONE_TRACE") or None
+    if trace:
+        from ..obs import tracer as _obs_tracer
+
+        _obs_tracer.start(path=trace)
+
+
+def export_trace(path: Optional[str] = None) -> Optional[str]:
+    """Write the configured trace NOW (Chrome-trace JSON + top-N summary
+    log + autocache audit log). Returns the path written, or None when
+    tracing was never configured — callers (the CLI's ``finally``) can
+    invoke it unconditionally."""
+    from ..obs import tracer as _obs_tracer
+
+    return _obs_tracer.export(path)

@@ -58,8 +58,15 @@ def phase(name: str, sync: Optional[Any] = None):
                     import jax
 
                     jax.block_until_ready(target)
+                except (ImportError, TypeError):
+                    pass  # no jax / non-blockable value: nothing to sync
                 except Exception:
-                    pass
+                    # a REAL device error (stream failure, dead backend):
+                    # swallowing it would silently misattribute every
+                    # later phase — surface it, keep timing
+                    logger.warning(
+                        "phase %s: device sync failed", name, exc_info=True
+                    )
         dt = time.perf_counter() - t0
         with _lock:
             _totals[name] += dt
@@ -75,9 +82,16 @@ def record(name: str, seconds: float) -> None:
 
 
 def reset() -> None:
+    """Clear phase totals AND the obs rate-limiter state: a fresh
+    measurement epoch (back-to-back bench runs in one process) must get
+    its first periodic log, not inherit the previous run's suppression
+    window."""
     with _lock:
         _totals.clear()
         _counts.clear()
+    from . import obs
+
+    obs.reset_rate_limits()
 
 
 def snapshot(prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:

@@ -27,6 +27,7 @@ import jax
 import numpy as np
 
 from ..data.dataset import Dataset
+from ..obs import tracer as obs_tracer
 from .executor import GraphExecutor
 from .graph import Graph, NodeId
 from .node_optimization import _sampled_graph
@@ -53,35 +54,37 @@ class Profile:
 
 
 def _result_bytes(value) -> float:
-    if isinstance(value, Dataset):
-        if value.is_batched:
-            return float(
-                sum(
-                    np.prod(a.shape) * a.dtype.itemsize
-                    for a in jax.tree_util.tree_leaves(value.payload)
-                )
-            )
+    from ..obs.span import cheap_nbytes
+
+    if isinstance(value, Dataset) and not value.is_batched:
+        # profiling MAY force materialization (that's its job, unlike the
+        # tracer's no-side-effect sizing): item lists collect and sum
         return float(
             sum(getattr(np.asarray(x), "nbytes", 64) for x in value.collect())
         )
-    return 64.0
+    n = cheap_nbytes(value)
+    return 64.0 if n is None else float(n)
 
 
 def _profile_at_scale(graph: Graph, sample_size: int) -> Dict[NodeId, Profile]:
     sampled = _sampled_graph(graph, sample_size)
     executor = GraphExecutor(sampled, optimize=False)
     profiles: Dict[NodeId, Profile] = {}
-    for gid in analysis.linearize(sampled):
-        if not isinstance(gid, NodeId):
-            continue
-        try:
-            t0 = time.perf_counter_ns()
-            value = executor.execute(gid).get()
-            elapsed = time.perf_counter_ns() - t0
-        except Exception as e:
-            logger.debug("profiling skipped %s: %s", gid, e)
-            continue
-        profiles[gid] = Profile(float(elapsed), _result_bytes(value))
+    # profiling pulls run at sampled scale over a TRUNCATED graph whose
+    # node ids collide with the production graph's — suspend tracing so
+    # they can't pollute the real span registry / audit observations
+    with obs_tracer.suspended():
+        for gid in analysis.linearize(sampled):
+            if not isinstance(gid, NodeId):
+                continue
+            try:
+                t0 = time.perf_counter_ns()
+                value = executor.execute(gid).get()
+                elapsed = time.perf_counter_ns() - t0
+            except Exception as e:
+                logger.debug("profiling skipped %s: %s", gid, e)
+                continue
+            profiles[gid] = Profile(float(elapsed), _result_bytes(value))
     return profiles
 
 
@@ -264,6 +267,7 @@ class AutoCacheRule(Rule):
     def apply(
         self, graph: Graph, annotations: Annotations
     ) -> Tuple[Graph, Annotations]:
+        profiles: Optional[Dict[NodeId, Profile]] = None
         if self.strategy == "aggressive":
             selected = self._select_aggressive(graph)
         else:
@@ -278,6 +282,7 @@ class AutoCacheRule(Rule):
                 else _device_budget_bytes()
             )
             selected = self._select_greedy(graph, profiles, float(budget))
+        self._record_plan(graph, profiles, selected)
         if selected:
             logger.info(
                 "auto-cache (%s): inserting Cacher after %d nodes (%s)",
@@ -291,6 +296,35 @@ class AutoCacheRule(Rule):
         annotations = dict(annotations)
         annotations[AUTOCACHE_ACTIVE] = True  # type: ignore[index]
         return graph, annotations
+
+    @staticmethod
+    def _record_plan(
+        graph: Graph,
+        profiles: Optional[Dict[NodeId, Profile]],
+        selected: set,
+    ) -> None:
+        """Log the planner's per-node estimates into the trace so the
+        estimate-vs-observed audit (obs/audit.py) can close the
+        profile-guided-caching feedback loop after execution. Node ids are
+        recorded BEFORE Cacher insertion (insert_cachers preserves the
+        planned nodes' ids) and match the executor's span ``node`` field
+        as long as later rewrites (trace fusion) leave the node in place —
+        the audit flags the ones that disappear."""
+        tracer = obs_tracer.current()
+        if tracer is None:
+            return
+        estimated = set(profiles or ())
+        for n in estimated | set(selected):
+            if n not in graph.nodes:
+                continue
+            p = (profiles or {}).get(n)
+            tracer.record_node_estimate(
+                str(n.id),
+                graph.get_operator(n).label,
+                est_seconds=None if p is None else p.ns / 1e9,
+                est_bytes=None if p is None else p.mem_bytes,
+                cacher=n in selected,
+            )
 
 
 def _full_input_size(graph: Graph) -> int:

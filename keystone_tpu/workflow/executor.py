@@ -9,8 +9,10 @@ saveable prefixes (annotated by the optimizer) are written into the global
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
+from ..obs.tracer import current as _trace_current
 from .env import PipelineEnv
 from .expressions import Expression
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
@@ -72,16 +74,29 @@ class GraphExecutor:
             raise ValueError(f"cannot execute unconnected {graph_id}")
         if isinstance(graph_id, SinkId):
             return self._execute(graph.get_sink_dependency(graph_id), transient)
+        # tracing is opt-in: disabled, the ONLY cost per pull is this None
+        # check — no span allocation anywhere on the path
+        tracer = _trace_current()
         if graph_id in self._state:
+            if tracer is not None:
+                self._trace_hit(tracer, graph, graph_id, store="state")
             return self._state[graph_id]
         if graph_id in transient:
+            if tracer is not None:
+                self._trace_hit(tracer, graph, graph_id, store="transient")
             return transient[graph_id]
         deps = [
             self._execute(d, transient) for d in graph.get_dependencies(graph_id)
         ]
         op = graph.get_operator(graph_id)
-        expr = op.execute(deps)
-        if self._retain(graph, graph_id):
+        retained = self._retain(graph, graph_id)
+        if tracer is None:
+            expr = op.execute(deps)
+        else:
+            expr = self._traced_execute(
+                tracer, graph_id, op, deps, retained=retained
+            )
+        if retained:
             self._state[graph_id] = expr
         else:
             # shared within this pull (diamonds compute once), dropped after
@@ -89,4 +104,68 @@ class GraphExecutor:
         prefix = self._annotations.get(graph_id)
         if prefix is not None:
             PipelineEnv.get_or_create().state[prefix] = expr
+        return expr
+
+    # -- tracing hooks (active only with an installed obs.Tracer) -------
+
+    @staticmethod
+    def _trace_hit(tracer, graph: Graph, graph_id: NodeId, store: str) -> None:
+        """A memoized result was returned instead of recomputed — the
+        Cacher/memo hit the span tree records against the recompute case."""
+        op = graph.get_operator(graph_id)
+        tracer.instant(
+            f"node.{op.label}",
+            node_id=str(graph_id.id),
+            op_type=type(op).__name__,
+            cache="hit",
+            store=store,
+        )
+
+    @staticmethod
+    def _traced_execute(tracer, graph_id: NodeId, op, deps, retained: bool):
+        """Build the node's expression with its eventual EVALUATION wrapped
+        in a span. Evaluation is lazy (``Expression`` thunks), so the span
+        opens when ``.get()`` first forces this node — upstream thunks
+        forced from inside it become child spans, giving the pull's true
+        tree. Exit blocks on the result so async-dispatched device time is
+        attributed here (recorded as ``sync_seconds``)."""
+        from ..obs.span import Span, cheap_nbytes
+
+        name = f"node.{op.label}"
+        op_type = type(op).__name__
+        node_id = str(graph_id.id)
+        t0 = time.perf_counter()
+        expr = op.execute(deps)
+        if expr.computed:
+            # eager operator (Dataset/Datum leaves, saved state): the work
+            # happened inside op.execute — record it directly
+            sp = Span(
+                name=name,
+                start=t0,
+                end=time.perf_counter(),
+                node_id=node_id,
+                op_type=op_type,
+                cache="miss",
+                output_bytes=cheap_nbytes(expr.get()),
+                attrs={"retained": retained, "eager": True},
+            )
+            tracer.record_complete(sp)
+            return expr
+
+        def _wrap(thunk):
+            def traced_thunk():
+                with tracer.span(
+                    name,
+                    node_id=node_id,
+                    op_type=op_type,
+                    cache="miss",
+                    retained=retained,
+                ) as sp:
+                    value = thunk()
+                    sp.sync_on(value)
+                return value
+
+            return traced_thunk
+
+        expr.map_thunk(_wrap)
         return expr

@@ -34,6 +34,14 @@ class Expression:
             self._thunk = None  # release captured state
         return self._value
 
+    def map_thunk(self, wrap: Callable[[Callable[[], Any]], Callable[[], Any]]) -> None:
+        """Replace the pending thunk with ``wrap(thunk)``; no-op once
+        computed. This is how the tracing executor attributes wall-clock to
+        the node that actually COMPUTES (evaluation is lazy — timing
+        ``Operator.execute`` would only measure thunk construction)."""
+        if self._value is _UNSET:
+            self._thunk = wrap(self._thunk)
+
     @staticmethod
     def now(value: Any) -> "Expression":
         e = Expression(lambda: value)

@@ -17,6 +17,7 @@ import logging
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 from ..data.dataset import Dataset
+from ..obs.tracer import current as _trace_current
 from .env import PipelineEnv
 from .executor import GraphExecutor
 from .expressions import DatasetExpression, DatumExpression, Expression
@@ -83,7 +84,14 @@ class PipelineResult:
         return self._executor.execute(self._sink)
 
     def get(self) -> Any:
-        return self.expression().get()
+        tracer = _trace_current()
+        if tracer is None:
+            return self.expression().get()
+        # the pull root: every node span of this execution nests under it
+        with tracer.span("pipeline.pull", op_type=type(self).__name__) as sp:
+            value = self.expression().get()
+            sp.sync_on(value)
+        return value
 
 
 class PipelineDataset(PipelineResult):
@@ -261,6 +269,13 @@ class Pipeline(Chainable):
         pipeline (parity: ``Pipeline.scala:38-65``). This is the jit boundary:
         the returned :class:`FittedPipeline` contains no estimators and can be
         compiled to a single XLA computation."""
+        tracer = _trace_current()
+        if tracer is None:
+            return self._fit()
+        with tracer.span("pipeline.fit", op_type=type(self).__name__):
+            return self._fit()
+
+    def _fit(self) -> "FittedPipeline":
         optimizer = PipelineEnv.get_or_create().optimizer
         graph, annotations = optimizer.execute(self._graph)
         executor = GraphExecutor(graph, optimize=False)
@@ -378,7 +393,13 @@ class FittedPipeline(Chainable):
         graph = graph.replace_dependency(self._source, data_id)
         graph = graph.remove_source(self._source)
         executor = GraphExecutor(graph, optimize=False)
-        return executor.execute(self._sink).get()
+        tracer = _trace_current()
+        if tracer is None:
+            return executor.execute(self._sink).get()
+        with tracer.span("pipeline.apply", op_type=type(self).__name__) as sp:
+            value = executor.execute(self._sink).get()
+            sp.sync_on(value)
+        return value
 
     def apply_datum(self, datum: Any) -> Any:
         graph, datum_id = attach_datum(self._graph, datum)

@@ -1,6 +1,7 @@
 """Observability configuration (utils/obs.py + CLI --logLevel/--profile)."""
 
 import logging
+import threading
 
 from keystone_tpu.utils import obs, timing
 
@@ -52,3 +53,89 @@ def test_bad_env_level_falls_back(monkeypatch, capsys):
     import logging
 
     assert logging.getLogger().level == logging.WARNING
+
+
+def test_configure_is_idempotent_one_handler():
+    """Repeated configure() must re-level, not stack stream handlers
+    (stacked handlers double every log line)."""
+    obs.configure("info")
+    root = logging.getLogger()
+    n_handlers = len(root.handlers)
+    obs.configure("debug")
+    obs.configure("warning")
+    assert len(root.handlers) == n_handlers
+    assert root.level == logging.WARNING
+
+
+def test_every_under_concurrent_callers():
+    """N threads racing one key: exactly one winner per window."""
+    key = "test.concurrent.every"
+    obs.reset_rate_limits()
+    results = []
+    barrier = threading.Barrier(8)
+
+    def hit():
+        barrier.wait(timeout=5)
+        results.append(obs.every(key, 60.0))
+
+    threads = [threading.Thread(target=hit) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results) == 1
+
+
+def test_timing_reset_clears_rate_limits():
+    """Back-to-back bench runs in one process: timing.reset() must give
+    the new run its FIRST periodic log instead of inheriting the old
+    run's suppression window."""
+    key = "test.reset.every"
+    assert obs.every(key, 3600.0) is True
+    assert obs.every(key, 3600.0) is False  # suppressed within the window
+    timing.reset()
+    assert obs.every(key, 3600.0) is True  # fresh epoch logs immediately
+
+
+def test_phase_holder_sync_path():
+    """A value appended to the yielded holder is what the phase blocks on
+    at exit (the async-dispatch attribution contract)."""
+    import jax.numpy as jnp
+
+    obs.configure("warning", profile=True)
+    try:
+        timing.reset()
+        with timing.phase("obs.holder_sync") as holder:
+            holder.append(jnp.ones((4,)) * 2.0)
+        snap = timing.snapshot()
+        assert snap["obs.holder_sync"]["calls"] == 1
+        assert snap["obs.holder_sync"]["seconds"] >= 0.0
+    finally:
+        obs.configure("warning", profile=False)
+
+
+def test_phase_sync_failure_is_logged_not_swallowed(caplog):
+    """A REAL device error during the phase-exit sync must surface at
+    WARNING (the bare-except that ate stream failures is gone) while the
+    phase still records; non-blockable values stay silent."""
+
+    class _Boom:
+        def block_until_ready(self):
+            raise RuntimeError("sync exploded")
+
+    obs.configure("warning", profile=True)
+    try:
+        timing.reset()
+        with caplog.at_level(logging.WARNING, logger="keystone_tpu.utils.timing"):
+            with timing.phase("obs.sync_fail", sync=_Boom()):
+                pass
+        assert "device sync failed" in caplog.text
+        assert timing.snapshot()["obs.sync_fail"]["calls"] == 1
+
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="keystone_tpu.utils.timing"):
+            with timing.phase("obs.sync_plain", sync=object()):
+                pass  # plain objects pass through jax untouched — no noise
+        assert "device sync failed" not in caplog.text
+    finally:
+        obs.configure("warning", profile=False)

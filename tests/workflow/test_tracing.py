@@ -1,0 +1,338 @@
+"""Pipeline tracing subsystem (keystone_tpu/obs): span tree, executor
+cache hit/miss attribution, Chrome-trace export, the autocache
+estimate-vs-observed audit, serving micro-batch spans, and the CLI
+``--trace`` wiring."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.obs import tracer as trace_mod
+from keystone_tpu.obs.audit import cache_audit, log_cache_audit
+from keystone_tpu.obs.export import (
+    format_top_spans,
+    to_chrome_trace,
+    write_chrome_trace,
+)
+from keystone_tpu.workflow.executor import GraphExecutor
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator
+from keystone_tpu.workflow.transformer import FunctionNode, Transformer
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    """Tracing must never leak across tests — a leaked tracer would add a
+    device sync to every executor pull in the rest of the suite."""
+    trace_mod.reset()
+    yield
+    trace_mod.reset()
+
+
+def _installed():
+    return trace_mod.install(trace_mod.Tracer())
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+
+def test_span_tree_nesting_and_ids():
+    t = trace_mod.Tracer()
+    with t.span("outer") as outer:
+        with t.span("inner") as inner:
+            pass
+    spans = {sp.name: sp for sp in t.spans()}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["inner"].depth == 1
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].end >= spans["inner"].end
+    assert outer.span_id != inner.span_id
+
+
+def test_span_stacks_are_per_thread():
+    t = trace_mod.Tracer()
+    started = threading.Barrier(2)
+
+    def work(name):
+        with t.span(name):
+            started.wait(timeout=5)
+
+    threads = [threading.Thread(target=work, args=(f"t{i}",)) for i in (0, 1)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # both spans overlapped in time yet neither parents the other
+    assert all(sp.parent_id is None for sp in t.spans())
+    assert {sp.name for sp in t.spans()} == {"t0", "t1"}
+
+
+def test_disabled_tracing_records_nothing():
+    t = trace_mod.Tracer()
+    trace_mod.install(t)
+    trace_mod.stop()
+    fitted = (
+        FunctionNode(batch_fn=lambda X: X * 2.0, label="double")
+        .to_pipeline()
+        .fit()
+    )
+    fitted.apply(np.ones((3, 2), np.float32))
+    assert trace_mod.current() is None
+    assert t.spans() == []
+
+
+def test_suspended_reinstalls_tracer():
+    t = _installed()
+    with trace_mod.suspended():
+        assert trace_mod.current() is None
+    assert trace_mod.current() is t
+
+
+# ---------------------------------------------------------------------------
+# executor instrumentation
+# ---------------------------------------------------------------------------
+
+
+class _Scale(Transformer):
+    def __init__(self, factor):
+        self.factor = factor
+
+    def apply(self, x):
+        return x * self.factor
+
+
+def _chain_graph():
+    g = Graph()
+    g, leaf = g.add_node(
+        DatasetOperator(Dataset(np.ones((4, 2), np.float32), batched=True)), []
+    )
+    g, n1 = g.add_node(_Scale(2.0), [leaf])
+    g, n2 = g.add_node(_Scale(3.0), [n1])
+    g, sink = g.add_sink(n2)
+    return g, (leaf, n1, n2), sink
+
+
+def test_executor_records_miss_then_hit_spans():
+    g, (leaf, n1, n2), sink = _chain_graph()
+    t = _installed()
+    ex = GraphExecutor(g, optimize=False)
+    ex.execute(sink).get()
+    misses = [sp for sp in t.spans() if sp.cache == "miss"]
+    assert {sp.node_id for sp in misses} == {
+        str(leaf.id), str(n1.id), str(n2.id)
+    }
+    for sp in misses:
+        assert sp.op_type in ("DatasetOperator", "_Scale")
+        assert sp.sync_seconds >= 0.0
+    # a second pull returns the memoized sink expression: hit, no recompute
+    before = len(t.spans())
+    ex.execute(sink).get()
+    new = t.spans()[before:]
+    assert [sp.cache for sp in new] == ["hit"]
+    assert new[0].node_id == str(n2.id)
+    assert new[0].instant
+
+
+def test_executor_span_reports_output_bytes():
+    g, (leaf, n1, n2), sink = _chain_graph()
+    t = _installed()
+    GraphExecutor(g, optimize=False).execute(sink).get()
+    sp = next(s for s in t.spans() if s.node_id == str(n2.id))
+    assert sp.output_bytes == 4 * 2 * 4  # (4,2) float32
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_well_formed(tmp_path):
+    g, _, sink = _chain_graph()
+    t = _installed()
+    GraphExecutor(g, optimize=False).execute(sink).get()
+    GraphExecutor(g, optimize=False).execute(sink).get()  # fresh miss spans
+    doc = to_chrome_trace(t)
+    events = doc["traceEvents"]
+    assert events
+    ts = [e["ts"] for e in events]
+    assert all(b >= a for a, b in zip(ts, ts[1:])), "ts must be monotonic"
+    complete = [e for e in events if e["ph"] == "X"]
+    assert all("dur" in e and e["dur"] >= 0 for e in complete)
+    assert any(e["args"].get("cache") == "miss" for e in complete)
+    path = tmp_path / "trace.json"
+    write_chrome_trace(t, str(path))
+    assert json.loads(path.read_text())["traceEvents"]
+
+
+def test_top_summary_and_schema():
+    g, _, sink = _chain_graph()
+    t = _installed()
+    GraphExecutor(g, optimize=False).execute(sink).get()
+    summary = t.span_summary()
+    assert summary
+    for row in summary.values():
+        # the one shape shared with timing.snapshot / metrics "phases"
+        assert {"seconds", "calls"} <= set(row)
+    text = format_top_spans(t, n=3)
+    assert "node." in text and "seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# autocache audit
+# ---------------------------------------------------------------------------
+
+
+def _reused_dag():
+    """leaf → a → b → (c, d): b is consumed twice, so greedy caches it."""
+    g = Graph()
+    g, leaf = g.add_node(
+        DatasetOperator(Dataset(np.ones((4, 2), np.float32), batched=True)), []
+    )
+    g, a = g.add_node(_Scale(2.0), [leaf])
+    g, b = g.add_node(_Scale(3.0), [a])
+    g, c = g.add_node(_Scale(4.0), [b])
+    g, d = g.add_node(_Scale(5.0), [b])
+    g, s1 = g.add_sink(c)
+    g, s2 = g.add_sink(d)
+    return g, (a, b, c, d), (s1, s2)
+
+
+def test_cache_audit_covers_every_cacher_annotated_node(caplog):
+    from keystone_tpu.workflow.autocache import AutoCacheRule, Profile
+
+    g, (a, b, c, d), (s1, s2) = _reused_dag()
+    profiles = {
+        a: Profile(ns=1e6, mem_bytes=100),
+        b: Profile(ns=5e6, mem_bytes=200),  # expensive + reused → cached
+        c: Profile(ns=1e3, mem_bytes=50),
+        d: Profile(ns=1e3, mem_bytes=50),
+    }
+    t = _installed()
+    g2, ann = AutoCacheRule("greedy", 10_000, profiles).apply(g, {})
+    ex = GraphExecutor(g2, optimize=False)
+    ex._annotations = ann
+    ex.execute(s1).get()
+    ex.execute(s2).get()
+
+    rows = cache_audit(t)
+    by_node = {r["node"]: r for r in rows}
+    cachers = {
+        str(g2.get_dependencies(n)[0].id)
+        for n in g2.nodes
+        if type(g2.get_operator(n)).__name__ == "Cacher"
+    }
+    assert cachers, "greedy must have inserted at least one Cacher"
+    # the audit covers every Cacher-annotated node, with estimate AND
+    # observation joined (the feedback loop the reference never closed)
+    for node in cachers:
+        row = by_node[node]
+        assert row["cacher"] is True
+        assert row["observed"] is True
+        assert row["est_seconds"] > 0 and row["obs_seconds"] is not None
+        assert row["est_bytes"] > 0 and row["obs_bytes"] is not None
+    # every profiled node is audited, cached or not
+    assert {str(n.id) for n in profiles} <= set(by_node)
+
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="keystone_tpu.obs.audit"):
+        assert log_cache_audit(t) == rows
+    assert "autocache audit" in caplog.text
+
+
+def test_observed_seconds_are_exclusive_of_children():
+    """Lazy evaluation nests upstream spans inside downstream ones; the
+    audit's observations must subtract child time or every downstream
+    node reads as mis-estimated (inclusive-vs-exclusive mismatch)."""
+    import time
+
+    from keystone_tpu.obs.audit import observed_by_node
+
+    t = trace_mod.Tracer()
+    with t.span("node.parent", node_id="1", cache="miss"):
+        with t.span("node.child", node_id="2", cache="miss"):
+            time.sleep(0.05)
+    obs = observed_by_node(t)
+    assert obs["2"]["seconds"] >= 0.045
+    assert obs["1"]["seconds"] < 0.04, "child time must not count twice"
+
+
+def test_profiling_runs_do_not_pollute_the_trace():
+    from keystone_tpu.workflow.autocache import profile_nodes
+
+    g, _, _ = _reused_dag()
+    t = _installed()
+    profile_nodes(g, sample_sizes=(2,), full_size=4)
+    assert t.spans() == [], "sampled-scale profiling pulls must be suspended"
+
+
+# ---------------------------------------------------------------------------
+# serving spans
+# ---------------------------------------------------------------------------
+
+
+def test_serving_microbatch_span_and_metrics_alignment():
+    from keystone_tpu.serving.engine import ServingEngine
+
+    fitted = (
+        FunctionNode(batch_fn=lambda X: X * 2.0, label="double")
+        >> FunctionNode(batch_fn=lambda X: X.sum(axis=1), label="rowsum")
+    ).fit()
+    t = _installed()
+    engine = ServingEngine(fitted, buckets=(4,), datum_shape=(2,))
+    with engine:
+        engine.predict(np.ones(2, np.float32), timeout=30.0)
+    spans = [sp for sp in t.spans() if sp.name == "serve.microbatch"]
+    assert spans and spans[0].attrs["bucket"] == 4
+    snap = engine.metrics.snapshot()
+    assert "serve.microbatch" in snap["spans"]
+    # phases and spans share one {name: {seconds, calls, ...}} schema and
+    # disjoint names, so they concatenate without collisions
+    merged = {**snap["phases"], **snap["spans"]}
+    assert len(merged) == len(snap["phases"]) + len(snap["spans"])
+    for row in merged.values():
+        assert {"seconds", "calls"} <= set(row)
+
+
+def test_metrics_spans_empty_without_tracer():
+    from keystone_tpu.serving.metrics import MetricsRegistry
+
+    assert MetricsRegistry("t").snapshot()["spans"] == {}
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+
+
+def test_cli_trace_flag_writes_chrome_trace(tmp_path, capsys):
+    from keystone_tpu.__main__ import main
+
+    path = tmp_path / "t.json"
+    rc = main([
+        "mnist", "--numFFTs", "2", "--blockSize", "512", "--lambda", "100",
+        "--trace", str(path),
+    ])
+    assert rc == 0
+    assert "TEST Error" in capsys.readouterr().out
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    assert events
+    ts = [e["ts"] for e in events]
+    assert all(b >= a for a, b in zip(ts, ts[1:]))
+    node_events = [e for e in events if e.get("args", {}).get("node")]
+    assert node_events, "expected per-DAG-node spans"
+    assert any(e["args"].get("cache") for e in node_events)
+    assert any(e["name"] == "pipeline.fit" for e in events)
+
+
+def test_cli_alias_rejects_unknown_name():
+    from keystone_tpu.__main__ import main
+
+    with pytest.raises(SystemExit):
+        main(["mnits"])
