@@ -2,8 +2,11 @@
 # Smoke-test pipeline tracing end-to-end: run the MNIST pipeline on CPU
 # at a tier-1-fast config with --trace, then validate the output is
 # well-formed Chrome-trace JSON — non-empty traceEvents, monotonic ts,
-# and at least one cache-annotated DAG-node span. Exits non-zero on any
-# failure. Extra flags pass through to the pipeline, e.g.:
+# and at least one cache-annotated DAG-node span. A second stage runs a
+# chunked out-of-core scan under tracing and asserts the pipelined scan
+# runtime's `scan.pipeline` spans (with the producer/consumer stall
+# counters) land in the trace. Exits non-zero on any failure. Extra
+# flags pass through to the pipeline, e.g.:
 #   bin/trace-smoke.sh /tmp/trace.json --numFFTs 4
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -25,4 +28,42 @@ assert any(
     e.get("args", {}).get("cache") for e in events
 ), "no cache-annotated DAG-node spans"
 print(f"TRACE OK: {len(events)} events -> {sys.argv[1]}")
+PY
+
+# -- pipelined-scan spans ----------------------------------------------------
+scan_out="$(mktemp /tmp/keystone-scan-trace-XXXXXX.json)"
+env JAX_PLATFORMS=cpu KEYSTONE_TRACE="$scan_out" python - "$scan_out" <<'PY'
+import json
+import sys
+
+import numpy as np
+
+from keystone_tpu.utils.obs import configure, export_trace
+
+configure()
+
+from keystone_tpu.data import ChunkedDataset
+
+ds = ChunkedDataset.from_array(
+    np.ones((64, 4), np.float32), 9
+).map_batch(lambda c: c * 2.0)
+assert float(np.asarray(ds.to_array()).sum()) == 64 * 4 * 2.0
+path = export_trace()
+assert path == sys.argv[1], (path, sys.argv[1])
+with open(path) as f:
+    doc = json.load(f)
+scans = [e for e in doc["traceEvents"] if e["name"] == "scan.pipeline"]
+assert scans, "no scan.pipeline spans in the trace"
+args = scans[-1]["args"]
+for key in (
+    "chunks",
+    "producer_seconds",
+    "producer_stall_seconds",
+    "consumer_stall_seconds",
+    "staged_bytes",
+    "occupancy_max",
+):
+    assert key in args, (key, args)
+assert args["chunks"] == 8  # ceil(64/9)
+print(f"SCAN SPANS OK: {len(scans)} scan.pipeline span(s) -> {path}")
 PY

@@ -1,5 +1,14 @@
-from .chunked import ChunkedDataset
+from .chunked import ChunkedDataset, prefetch_to_device
 from .dataset import Dataset
+from .pipeline_scan import ChunkPadder, ScanPipeline, scan_pipeline
 from .sparse import SparseRows
 
-__all__ = ["ChunkedDataset", "Dataset", "SparseRows"]
+__all__ = [
+    "ChunkPadder",
+    "ChunkedDataset",
+    "Dataset",
+    "ScanPipeline",
+    "SparseRows",
+    "prefetch_to_device",
+    "scan_pipeline",
+]

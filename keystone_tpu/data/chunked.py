@@ -25,23 +25,15 @@ import os
 from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import jax
-import numpy as np
 
 from .dataset import Dataset, _rebatch
-
-
-def _payload_rows(payload: Any) -> int:
-    leaves = jax.tree_util.tree_leaves(payload)
-    return int(leaves[0].shape[0])
-
-
-def _payload_bytes(payload: Any) -> int:
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(payload):
-        total += int(np.prod(leaf.shape)) * int(
-            np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
-        )
-    return total
+from .pipeline_scan import (
+    map_workers,
+    payload_nbytes as _payload_bytes,
+    payload_rows as _payload_rows,
+    scan_pipeline,
+    serial_staged,
+)
 
 
 def default_cache_budget_bytes() -> int:
@@ -56,30 +48,12 @@ def default_cache_budget_bytes() -> int:
 
 def prefetch_to_device(chunks, depth: int = 2):
     """Iterate ``chunks`` with up to ``depth`` device uploads in flight —
-    fit-ingestion double buffering (VERDICT r4 weak #4). Host (numpy)
-    chunks are ``jax.device_put`` ahead of the consumer so the H2D
-    transfer streams while the previous chunk's compute runs; device
-    arrays pass through untouched. Order is preserved."""
-    from collections import deque
-
-    q: deque = deque()
-    it = iter(chunks)
-
-    def put(c):
-        leaves = jax.tree_util.tree_leaves(c)
-        if any(isinstance(leaf, np.ndarray) for leaf in leaves):
-            return jax.device_put(c)
-        return c
-
-    while True:
-        while it is not None and len(q) < depth:
-            try:
-                q.append(put(next(it)))
-            except StopIteration:
-                it = None
-        if not q:
-            return
-        yield q.popleft()
+    fit-ingestion double buffering (VERDICT r4 weak #4). Superseded by the
+    pipelined scan runtime (``pipeline_scan.scan_pipeline``, which adds a
+    producer thread in front of the same staging ring); kept as the
+    serial/legacy spelling and as the ``KEYSTONE_SCAN_PIPELINE=0``
+    fallback. Order is preserved."""
+    return serial_staged(chunks, depth)
 
 
 def rechunk_batched(dataset: "Dataset", sizes: Sequence[int]) -> "ChunkedDataset":
@@ -218,17 +192,39 @@ class ChunkedDataset(Dataset):
         return self._num_rows
 
     def chunks(self) -> Iterator[Any]:
-        """One scan: recomputes the whole lazy chain chunk-by-chunk."""
+        """One scan: recomputes the whole lazy chain chunk-by-chunk.
+
+        Runs through the pipelined scan runtime (``pipeline_scan.py``):
+        the chain executes in a background producer thread while an H2D
+        staging ring keeps device uploads ahead of the consumer, so host
+        production, transfer, and device compute overlap on every
+        streaming consumer. ``KEYSTONE_SCAN_PIPELINE=0`` restores the
+        serial in-thread scan."""
+        return scan_pipeline(self._payload(), label=self._label)
+
+    def raw_chunks(self) -> Iterator[Any]:
+        """One scan WITHOUT the pipelined runtime — for composition sites
+        that feed another scan (derived factories, solvers that wrap the
+        source in their own ``scan_pipeline``) where nesting pipelines
+        would stack threads for no additional overlap."""
         return iter(self._payload())
 
     def __iter__(self) -> Iterator[Any]:
-        for chunk in self.chunks():
+        # stage=False: per-row consumers are host code — hand them chunks
+        # in whatever form the chain produced (numpy stays numpy; no
+        # speculative H2D), while chain production still overlaps the
+        # per-row work in the producer thread
+        for chunk in scan_pipeline(
+            self._payload(), stage=False, label=f"{self._label}|iter"
+        ):
             rows = _payload_rows(chunk)
             for i in range(rows):
                 yield jax.tree_util.tree_map(lambda a: a[i], chunk)
 
     def first(self) -> Any:
-        chunk = next(self.chunks())
+        # one chunk of a raw scan: no producer thread, no staged readahead
+        # — first() must not pay depth chunks of production for one row
+        chunk = next(self.raw_chunks())
         return jax.tree_util.tree_map(lambda a: a[0], chunk)
 
     def to_array(self):
@@ -261,21 +257,43 @@ class ChunkedDataset(Dataset):
         )
 
     def map(self, fn: Callable[[Any], Any]) -> "ChunkedDataset":
-        """Per-item fallback, applied within each chunk and restacked."""
+        """Per-item fallback, applied within each chunk and restacked.
+
+        Items within a chunk run across an order-preserving thread pool
+        (size from ``KEYSTONE_MAP_WORKERS``, default min(4, cores); 1
+        disables it) — this path is host featurizers whose numpy work
+        releases the GIL, and the serial per-row loop was the dominant
+        cost of per-item chains over large chunks. Results are ordered,
+        but ``fn`` executes CONCURRENTLY within a chunk: an fn with
+        shared mutable state (a stateful rng, an accumulator closure)
+        needs ``KEYSTONE_MAP_WORKERS=1``."""
         parent = self._payload
 
         import jax.numpy as jnp
 
+        def one(chunk, i):
+            return jnp.asarray(
+                fn(jax.tree_util.tree_map(lambda a: a[i], chunk))
+            )
+
         def factory():
-            for chunk in parent():
-                rows = _payload_rows(chunk)
-                items = [
-                    jnp.asarray(
-                        fn(jax.tree_util.tree_map(lambda a: a[i], chunk))
-                    )
-                    for i in range(rows)
-                ]
-                yield _rebatch(items).payload
+            from concurrent.futures import ThreadPoolExecutor
+
+            workers = map_workers()
+            pool = ThreadPoolExecutor(workers) if workers > 1 else None
+            try:
+                for chunk in parent():
+                    rows = _payload_rows(chunk)
+                    if pool is None or rows <= 1:
+                        items = [one(chunk, i) for i in range(rows)]
+                    else:
+                        items = list(
+                            pool.map(one, [chunk] * rows, range(rows))
+                        )
+                    yield _rebatch(items).payload
+            finally:
+                if pool is not None:
+                    pool.shutdown(wait=True)
 
         return ChunkedDataset(
             factory, self._num_rows, label=f"{self._label}|map"
@@ -293,20 +311,28 @@ class ChunkedDataset(Dataset):
         budget = default_cache_budget_bytes() if budget_bytes is None else budget_bytes
         it = self.chunks()
         try:
-            head = next(it)
-        except StopIteration:
-            raise ValueError("empty chunked dataset")
-        head_rows = _payload_rows(head)
-        est_total = _payload_bytes(head) * (self._num_rows / max(head_rows, 1))
-        if est_total > budget:
-            return self
-        parts: List[Any] = [head]
-        total = _payload_bytes(head)
-        for chunk in it:
-            total += _payload_bytes(chunk)
-            if total > budget:  # estimate was low (ragged chunks) — bail out
+            try:
+                head = next(it)
+            except StopIteration:
+                raise ValueError("empty chunked dataset")
+            head_rows = _payload_rows(head)
+            est_total = _payload_bytes(head) * (
+                self._num_rows / max(head_rows, 1)
+            )
+            if est_total > budget:
                 return self
-            parts.append(chunk)
+            parts: List[Any] = [head]
+            total = _payload_bytes(head)
+            for chunk in it:
+                total += _payload_bytes(chunk)
+                if total > budget:  # estimate was low (ragged chunks) — bail
+                    return self
+                parts.append(chunk)
+        finally:
+            # the over-budget paths abandon a live scan — join its producer
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
         payload = (
             parts[0]
             if len(parts) == 1

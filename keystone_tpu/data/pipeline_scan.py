@@ -1,0 +1,445 @@
+"""Pipelined out-of-core scans: overlap host production, H2D staging, and
+device compute.
+
+Every :class:`~keystone_tpu.data.chunked.ChunkedDataset` scan used to run
+serially: the host produced chunk *i* (tar decode, host featurizers,
+per-item Python maps) while the device sat idle, then the device computed
+while the host sat idle. The reference never sees this problem — Spark's
+RDD partition pipelining overlaps production and consumption for free
+(KeystoneML, arXiv:1610.09451) — and the follow-up performance study
+(arXiv:1612.01437) shows data movement, not FLOPs, is where distributed
+ML pipelines lose their time.
+
+:func:`scan_pipeline` is the TPU-native counterpart: a bounded
+producer/consumer pipeline with three overlapped stages —
+
+  * a background **producer** thread runs the whole lazy chunk chain (all
+    host work) into a bounded queue;
+  * an **H2D staging** ring issues ``jax.device_put`` up to ``depth``
+    chunks ahead of the consumer, so transfers stream while the previous
+    chunk's compute runs (generalizing and subsuming the old
+    ``prefetch_to_device`` double buffer);
+  * the **consumer** (streaming solver / fused chain / materializer)
+    overlaps its device compute with the next chunk's production.
+
+Contract: chunk order is preserved, producer exceptions surface in the
+consumer with the original traceback attached, and early consumer exit
+(``close()``, garbage collection of an abandoned iterator, or
+``GeneratorExit`` unwinding a wrapping generator) drains the buffer and
+joins the producer thread — no orphan threads, no deadlock.
+
+Knobs: ``KEYSTONE_SCAN_PIPELINE=0`` is the kill switch (serial scan, the
+staging double buffer kept); ``KEYSTONE_SCAN_DEPTH`` sets the buffer and
+staging depth (default 2); ``KEYSTONE_CHUNK_BUCKETS=0`` disables ragged-
+chunk shape bucketing (:class:`ChunkPadder`); ``KEYSTONE_MAP_WORKERS``
+sizes the per-chunk item thread pool in ``ChunkedDataset.map``.
+
+Per-scan counters (producer-stall vs consumer-stall seconds, staged H2D
+bytes, peak buffer occupancy) land as ``scan.pipeline`` spans in the
+tracer (``obs/scan.py``) when tracing is on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from queue import Empty, Full, Queue
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+DEFAULT_DEPTH = 2
+_JOIN_TIMEOUT = 5.0
+
+
+def _env_flag(name: str, default: bool = True) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+def pipeline_enabled() -> bool:
+    """KEYSTONE_SCAN_PIPELINE kill switch (default on). Read per scan so
+    a process can toggle it (bench A/B, test isolation)."""
+    return _env_flag("KEYSTONE_SCAN_PIPELINE", True)
+
+
+def bucketing_enabled() -> bool:
+    """KEYSTONE_CHUNK_BUCKETS switch for :class:`ChunkPadder` (default on)."""
+    return _env_flag("KEYSTONE_CHUNK_BUCKETS", True)
+
+
+def pipeline_depth() -> int:
+    try:
+        depth = int(os.environ.get("KEYSTONE_SCAN_DEPTH", DEFAULT_DEPTH))
+    except ValueError:
+        depth = DEFAULT_DEPTH
+    return max(1, depth)
+
+
+def map_workers() -> int:
+    """Pool size for ChunkedDataset.map's per-item fallback. Default
+    min(4, cores): the per-item fns are host featurizers whose numpy work
+    releases the GIL; 1 disables the pool."""
+    raw = os.environ.get("KEYSTONE_MAP_WORKERS")
+    if raw is not None:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return min(4, os.cpu_count() or 1)
+
+
+def payload_rows(payload: Any) -> int:
+    leaves = jax.tree_util.tree_leaves(payload)
+    return int(leaves[0].shape[0])
+
+
+def payload_nbytes(payload: Any) -> int:
+    """Materialized bytes of a chunk payload. Leaves without a dtype
+    (Python scalars, nested lists) are measured through numpy's view of
+    them rather than assumed float32 — ``cache()`` budget decisions
+    depend on this being right for float64/int8 payloads."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(payload):
+        dt = getattr(leaf, "dtype", None)
+        shape = getattr(leaf, "shape", None)
+        if dt is None or shape is None:
+            leaf = np.asarray(leaf)
+            dt, shape = leaf.dtype, leaf.shape
+        total += int(np.prod(shape)) * int(np.dtype(dt).itemsize)
+    return total
+
+
+def _stage_chunk(chunk: Any) -> Tuple[Any, int]:
+    """Issue the H2D transfer for host (numpy) chunks; device arrays and
+    non-array payloads pass through. Returns (staged_chunk, bytes_staged)."""
+    leaves = jax.tree_util.tree_leaves(chunk)
+    if any(isinstance(leaf, np.ndarray) for leaf in leaves):
+        return jax.device_put(chunk), payload_nbytes(chunk)
+    return chunk, 0
+
+
+@dataclass
+class ScanStats:
+    """Counters for one pipelined scan — the tracer schema's
+    ``scan.pipeline`` span attrs (obs/scan.py)."""
+
+    label: str = "scan"
+    depth: int = DEFAULT_DEPTH
+    chunks: int = 0
+    #: host production time inside the producer thread (next(source))
+    producer_seconds: float = 0.0
+    #: producer blocked on a full buffer (consumer-bound scan)
+    producer_stall_seconds: float = 0.0
+    #: consumer blocked on an empty buffer (producer-bound scan)
+    consumer_stall_seconds: float = 0.0
+    staged_bytes: int = 0
+    occupancy_max: int = 0
+    start: float = 0.0
+    end: float = 0.0
+
+
+_CHUNK, _ERROR, _DONE = 0, 1, 2
+
+
+def _producer_put(q: Queue, stop: threading.Event, stats: ScanStats, item) -> bool:
+    t0 = time.perf_counter()
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+        except Full:
+            continue
+        if item[0] == _CHUNK:
+            stats.producer_stall_seconds += time.perf_counter() - t0
+            occ = q.qsize()
+            if occ > stats.occupancy_max:
+                stats.occupancy_max = occ
+        return True
+    return False
+
+
+def _producer_loop(
+    source: Iterator[Any], q: Queue, stop: threading.Event, stats: ScanStats
+) -> None:
+    """The producer thread body. A MODULE-LEVEL function on purpose: the
+    thread must not hold a reference to the ScanPipeline, or an abandoned
+    iterator could never be garbage-collected (the thread registry would
+    pin it) and its producer would run to exhaustion unreaped."""
+    try:
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                item = next(source)
+            except StopIteration:
+                break
+            stats.producer_seconds += time.perf_counter() - t0
+            if not _producer_put(q, stop, stats, (_CHUNK, item)):
+                return
+    except BaseException as e:  # noqa: BLE001 — surfaces in the consumer
+        _producer_put(q, stop, stats, (_ERROR, e))
+        return
+    finally:
+        # deterministic cleanup of the chain (file handles, tar readers)
+        close = getattr(source, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+    _producer_put(q, stop, stats, (_DONE, None))
+
+
+class ScanPipeline:
+    """One pipelined scan: an order-preserving iterator of chunks backed
+    by a producer thread and a bounded buffer. See the module docstring
+    for the contract; construct through :func:`scan_pipeline`."""
+
+    def __init__(
+        self,
+        source: Any,
+        *,
+        depth: Optional[int] = None,
+        stage: bool = True,
+        label: str = "scan",
+    ):
+        self._depth = depth or pipeline_depth()
+        self._do_stage = stage
+        self._q: Queue = Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._staged: deque = deque()
+        self._source_done = False
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._recorded = False
+        self.stats = ScanStats(
+            label=label, depth=self._depth, start=time.perf_counter()
+        )
+        self._thread = threading.Thread(
+            target=_producer_loop,
+            args=(iter(source), self._q, self._stop, self.stats),
+            name=f"ks-scan[{label}]",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- consumer ---------------------------------------------------------
+
+    def __iter__(self) -> "ScanPipeline":
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise StopIteration
+        # top up the staging ring so `depth` H2D transfers are in flight
+        # while the caller computes on the chunk we hand back
+        while not self._source_done and len(self._staged) < self._depth:
+            if self._staged:
+                try:
+                    kind, payload = self._q.get_nowait()
+                except Empty:
+                    break  # staged work available — don't wait on the producer
+            else:
+                t0 = time.perf_counter()
+                kind, payload = self._get_blocking()
+                self.stats.consumer_stall_seconds += time.perf_counter() - t0
+            if kind == _DONE:
+                self._source_done = True
+            elif kind == _ERROR:
+                self._source_done = True
+                self._error = payload
+            else:
+                if self._do_stage:
+                    chunk, nbytes = _stage_chunk(payload)
+                    self.stats.staged_bytes += nbytes
+                else:
+                    chunk = payload
+                self._staged.append(chunk)
+        if self._staged:
+            self.stats.chunks += 1
+            return self._staged.popleft()
+        if self._error is not None:
+            err, self._error = self._error, None
+            self._shutdown()
+            raise err
+        self._shutdown()
+        raise StopIteration
+
+    def _get_blocking(self) -> Tuple[int, Any]:
+        while True:
+            try:
+                return self._q.get(timeout=0.1)
+            except Empty:
+                if not self._thread.is_alive():
+                    try:
+                        return self._q.get_nowait()
+                    except Empty:
+                        # producer died without a sentinel (process teardown
+                        # mid-scan) — fail loudly rather than hang
+                        raise RuntimeError(
+                            "scan pipeline producer thread died without "
+                            "finishing the scan"
+                        ) from None
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Early consumer exit: stop the producer, drain the buffer so a
+        blocked put unblocks, and join the thread."""
+        if self._closed:
+            return
+        self._stop.set()
+        self._drain()
+        self._shutdown()
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                self._q.get_nowait()
+            except Empty:
+                return
+
+    def _shutdown(self) -> None:
+        self._closed = True
+        self._source_done = True
+        self._stop.set()
+        self._staged.clear()
+        if self._thread.is_alive():
+            self._thread.join(timeout=_JOIN_TIMEOUT)
+        if self._recorded:
+            return
+        self._recorded = True
+        self.stats.end = time.perf_counter()
+        try:
+            from ..obs.scan import record_scan_span
+
+            record_scan_span(self.stats)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            if not self._closed:
+                self.close()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "ScanPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serial_staged(chunks: Any, depth: int = DEFAULT_DEPTH):
+    """The no-thread fallback (and the old ``prefetch_to_device`` body):
+    iterate ``chunks`` with up to ``depth`` device uploads in flight.
+    Host (numpy) chunks are ``jax.device_put`` ahead of the consumer so
+    the H2D transfer streams while the previous chunk's compute runs;
+    device arrays pass through untouched. Order is preserved."""
+    q: deque = deque()
+    it = iter(chunks)
+    while True:
+        while it is not None and len(q) < depth:
+            try:
+                q.append(_stage_chunk(next(it))[0])
+            except StopIteration:
+                it = None
+        if not q:
+            return
+        yield q.popleft()
+
+
+def scan_pipeline(
+    chunks: Any,
+    *,
+    depth: Optional[int] = None,
+    stage: bool = True,
+    label: str = "scan",
+):
+    """THE streaming-scan entry point: wrap any chunk iterable in the
+    pipelined runtime. Idempotent (an already-pipelined iterator passes
+    through, so solver sites can wrap ``dataset.chunks()`` blindly without
+    stacking threads). ``stage=False`` skips the H2D staging ring for
+    consumers that want host chunks. With ``KEYSTONE_SCAN_PIPELINE=0``
+    this degrades to the serial :func:`serial_staged` double buffer."""
+    if isinstance(chunks, ScanPipeline):
+        return chunks
+    if not pipeline_enabled():
+        if stage:
+            return serial_staged(chunks, depth or pipeline_depth())
+        return iter(chunks)
+    return ScanPipeline(chunks, depth=depth, stage=stage, label=label)
+
+
+# -- chunk-shape bucketing ---------------------------------------------------
+
+
+def bucket_ladder(lead_rows: int, levels: int = 4) -> Tuple[int, ...]:
+    """Bucket row counts for a scan whose lead chunk has ``lead_rows``:
+    ``{ceil(lead/2^i) for i < levels}``, ascending. A ragged tail pads to
+    the next bucket up (at most ~2× its own rows of wasted compute,
+    bounded by lead/2^(levels-1) pad rows), and a fused chain compiles at
+    most ``levels`` times per scan instead of once per distinct shape."""
+    return tuple(
+        sorted(
+            {
+                max(1, (lead_rows + (1 << i) - 1) >> i)
+                for i in range(max(1, levels))
+            }
+        )
+    )
+
+
+class ChunkPadder:
+    """Wrap a per-chunk callable so ragged (tail) chunks pad up to a small
+    static bucket ladder derived from the first chunk seen, killing the
+    one-XLA-compile-per-distinct-chunk-shape cost of fused chains over
+    out-of-core scans.
+
+    Padding repeats the chunk's first row (in-distribution for any
+    row-wise chain — the same trick as ``serving/batching.py`` and
+    ``FittedPipeline.apply_chunked``) and is sliced off the result, so
+    outputs are exact. The wrapped ``fn`` must be row-wise in its leading
+    axis (true for fused transformer chains; batch-coupled nodes are
+    rejected upstream). The ladder locks on the first chunk and is shared
+    across scans, so re-scans (lineage recompute) reuse the compiles.
+    ``KEYSTONE_CHUNK_BUCKETS=0`` makes this a transparent pass-through."""
+
+    def __init__(self, fn: Callable[[Any], Any], levels: int = 4):
+        self.fn = fn
+        self.levels = levels
+        self._buckets: Optional[Tuple[int, ...]] = None
+        self._lock = threading.Lock()
+
+    def __call__(self, chunk: Any) -> Any:
+        if not bucketing_enabled():
+            return self.fn(chunk)
+        rows = payload_rows(chunk)
+        if self._buckets is None:
+            with self._lock:
+                if self._buckets is None:
+                    self._buckets = bucket_ladder(rows, self.levels)
+        target = next((b for b in self._buckets if b >= rows), None)
+        if target is None or target == rows:
+            # at-or-above the lead shape: run unpadded (a growing source
+            # compiles per such shape, exactly as before)
+            return self.fn(chunk)
+        padded = jax.tree_util.tree_map(
+            lambda a: _pad_rows(a, rows, target), chunk
+        )
+        out = self.fn(padded)
+        return jax.tree_util.tree_map(lambda a: a[:rows], out)
+
+
+def _pad_rows(a: Any, rows: int, target: int):
+    import jax.numpy as jnp
+
+    a = jnp.asarray(a)
+    pad = jnp.broadcast_to(a[:1], (target - rows,) + a.shape[1:])
+    return jnp.concatenate([a, pad], axis=0)

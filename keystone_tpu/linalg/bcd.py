@@ -27,7 +27,7 @@ import jax.numpy as jnp
 # SOLVER_PRECISION and _mm live in row_matrix (the bottom of the linalg
 # stack); re-exported here because bcd is where the precision decision is
 # most visible to solver readers.
-from ..data.chunked import prefetch_to_device
+from ..data.pipeline_scan import scan_pipeline
 from .row_matrix import SOLVER_PRECISION, _mm, solve_spd  # noqa: F401
 
 
@@ -295,9 +295,15 @@ def solve_blockwise_l2_streaming(
     else:
         d = None
         # block layout needs d: peek it from the first chunk of one scan
-        for chunk in chunk_scan():
-            d = int(chunk.shape[1])
-            break
+        it = chunk_scan()
+        try:
+            for chunk in it:
+                d = int(chunk.shape[1])
+                break
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()  # abandoning a pipelined scan joins its producer
         if d is None:
             raise ValueError("empty chunk source")
     while j < d:
@@ -331,7 +337,7 @@ def solve_blockwise_l2_streaming(
             c = jnp.zeros((sizes[b], k), dtype=dtype)
             row0 = 0
             with phase("bcd.stream_block") as out:
-                for chunk in prefetch_to_device(chunk_scan()):
+                for chunk in scan_pipeline(chunk_scan(), label="bcd.stream"):
                     chunk = jnp.asarray(chunk, dtype=dtype)
                     pred, G, c = _stream_chunk_update(
                         chunk, pred, G, c, Ws[b],
@@ -362,7 +368,7 @@ def stream_column_means(chunk_scan, dtype=jnp.float32):
     the centering pass the streaming solvers run before accumulating."""
     sums = None
     n = 0
-    for chunk in prefetch_to_device(chunk_scan()):
+    for chunk in scan_pipeline(chunk_scan(), label="column_means"):
         chunk = jnp.asarray(chunk, dtype=dtype)
         s = jnp.sum(chunk, axis=0)
         sums = s if sums is None else sums + s

@@ -73,9 +73,13 @@ def solve_least_squares_streaming(chunks, reg: float = 0.0, dtype=jnp.float32):
     Returns the (d, k) solution. Parity: mlmatrix NormalEquations'
     map + treeReduce over row partitions (LinearMapper.scala:121-139) —
     the per-partition Gram contributions become per-chunk donated updates.
+    The source runs through the pipelined scan runtime so producing
+    (A, y) chunk *i+1* overlaps chunk *i*'s Gram accumulation.
     """
+    from ..data.pipeline_scan import scan_pipeline
+
     G = C = None
-    for A_chunk, y_chunk in chunks:
+    for A_chunk, y_chunk in scan_pipeline(chunks, label="normal_eq"):
         A_chunk = jnp.asarray(A_chunk, dtype=dtype)
         y_chunk = jnp.asarray(y_chunk, dtype=dtype)
         if y_chunk.ndim != 2 or A_chunk.ndim != 2:

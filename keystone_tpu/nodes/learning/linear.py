@@ -160,7 +160,17 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
         if isinstance(data, Dataset) and isinstance(data.payload, (list, tuple)):
             blocks = [jnp.asarray(p) for p in data.payload]
         elif isinstance(data, (list, tuple)):
-            blocks = [Dataset.of(d).to_array() for d in data]
+            # stage pre-split blocks through the pipelined scan: block i+1
+            # materializes (and its H2D transfer streams) while block i's
+            # device placement completes, instead of a serial eager loop
+            from ...data.pipeline_scan import scan_pipeline
+
+            blocks = list(
+                scan_pipeline(
+                    (Dataset.of(d).to_array() for d in data),
+                    label="block_ingest",
+                )
+            )
         else:
             X = Dataset.of(data).to_array()
             d = self.num_features or X.shape[-1]
@@ -238,16 +248,19 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
 
         y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
 
+        # raw (unpipelined) scans compose here: the streaming solvers wrap
+        # chunk_scan() in scan_pipeline themselves, so exactly ONE
+        # producer thread runs the whole chain per scan
         if self.num_features is not None:
             d = self.num_features
-            base_scan = data.chunks
+            base_scan = data.raw_chunks
 
             def chunk_scan():
                 for chunk in base_scan():
                     yield chunk[..., :d]
 
         else:
-            chunk_scan = data.chunks
+            chunk_scan = data.raw_chunks
 
         with phase("block_ls.stream_center") as out:
             mean_vec, n = stream_column_means(chunk_scan)

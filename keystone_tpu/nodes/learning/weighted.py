@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...data.chunked import prefetch_to_device
+from ...data.pipeline_scan import scan_pipeline
 from ...data.dataset import Dataset
 from ...linalg.row_matrix import solve_spd
 from ...parallel.mesh import shard_classes
@@ -354,16 +354,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             raise ValueError(
                 f"chunked features have {len(data)} rows, labels {n}"
             )
+        # raw (unpipelined) scans compose here; the consuming loops below
+        # wrap them in scan_pipeline so exactly ONE pipeline runs per scan
         if self.num_features is not None:
             dcap = self.num_features
-            base_scan = data.chunks
+            base_scan = data.raw_chunks
 
             def scan():
                 for chunk in base_scan():
                     yield chunk[..., :dcap]
 
         else:
-            scan = data.chunks
+            scan = data.raw_chunks
 
         y_idx = jnp.argmax(Y, axis=1)
         counts = jnp.zeros((k,), jnp.float32).at[y_idx].add(1.0)
@@ -399,7 +401,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 pop_sum = jnp.zeros((bs,), jnp.float32)
                 row0 = 0
                 with phase("wls.stream_cross") as out:
-                    for chunk in prefetch_to_device(scan()):
+                    for chunk in scan_pipeline(scan(), label="wls.stream"):
                         chunk = jnp.asarray(chunk, dtype=jnp.float32)
                         R, xtR, xtRc, G, class_sums, pop_sum = _wls_scan1(
                             chunk, R,
@@ -451,7 +453,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     )
                     row0 = 0
                     with phase("wls.stream_grams") as out:
-                        for chunk in prefetch_to_device(scan()):
+                        for chunk in scan_pipeline(scan(), label="wls.stream"):
                             chunk = jnp.asarray(chunk, dtype=jnp.float32)
                             grams = _wls_scan2(
                                 chunk, y_idx, grams, row0, j0, c0,

@@ -143,6 +143,15 @@ def _column_stats(X):
     return jnp.mean(X, axis=0), jnp.var(X, axis=0, ddof=1)
 
 
+@jax.jit
+def _chunk_center_stats(X):
+    """One chunk's (column mean, CENTERED sum of squares) — the
+    numerically-stable merge inputs for the streaming StandardScaler."""
+    mean = jnp.mean(X, axis=0)
+    diff = X - mean
+    return mean, jnp.sum(diff * diff, axis=0)
+
+
 class StandardScalerModel(Transformer):
     """(x − mean) / std; std of None means center-only
     (parity: StandardScaler.scala:16-32)."""
@@ -168,14 +177,46 @@ class StandardScaler(Estimator):
         self.eps = eps
 
     def fit(self, data: Dataset) -> StandardScalerModel:
-        X = data.to_array()
-        mean, var = _column_stats(X)
+        from ...data.chunked import ChunkedDataset
+
+        if isinstance(data, ChunkedDataset):
+            mean, var = self._streaming_stats(data)
+        else:
+            mean, var = _column_stats(data.to_array())
         if not self.normalize_std_dev:
             return StandardScalerModel(mean, None)
         std = jnp.sqrt(var)
         bad = jnp.isnan(std) | jnp.isinf(std) | (jnp.abs(std) < self.eps)
         std = jnp.where(bad, 1.0, std)
         return StandardScalerModel(mean, std)
+
+    @staticmethod
+    def _streaming_stats(data):
+        """Column mean/var(ddof=1) of a chunked set in ONE pipelined scan
+        — per-chunk centered statistics merged Chan/Welford-style (the
+        raw sum-of-squares form cancels catastrophically in f32 when
+        |mean| ≫ std) instead of materializing via ``to_array()``. Host
+        chunk production overlaps the device reductions."""
+        n = 0
+        mean = m2 = None
+        for chunk in data.chunks():
+            X = jnp.asarray(chunk)
+            nc = int(X.shape[0])
+            mc, m2c = _chunk_center_stats(X)
+            if mean is None:
+                n, mean, m2 = nc, mc, m2c
+            else:
+                tot = n + nc
+                delta = mc - mean
+                mean = mean + delta * (nc / tot)
+                m2 = m2 + m2c + delta * delta * (n * nc / tot)
+                n = tot
+        if mean is None:
+            raise ValueError("empty chunked dataset")
+        # sample variance (ddof=1), matching _column_stats; n==1 yields a
+        # zero m2 whose std the degenerate guard maps to 1.0
+        var = m2 / max(n - 1, 1)
+        return mean, var
 
 
 class Sampler(Transformer):
@@ -230,8 +271,10 @@ class ColumnSampler(Transformer):
             return data.map(self.apply)
         if isinstance(data, ChunkedDataset):
             # per-chunk device gather, lazily — the sampled set is small and
-            # materializes at the consumer; the descriptor stack never does
-            parent = data.chunks
+            # materializes at the consumer; the descriptor stack never does.
+            # raw_chunks: this factory COMPOSES into a downstream scan, which
+            # pipelines the whole chain once at its consumer
+            parent = data.raw_chunks
 
             def factory():
                 for i, chunk in enumerate(parent()):

@@ -13,15 +13,18 @@ Enable with ``KEYSTONE_TRACE=/path/trace.json`` (or the CLI's
 
 from .audit import cache_audit, log_cache_audit
 from .export import format_top_spans, to_chrome_trace, write_chrome_trace
+from .scan import SCAN_SPAN, record_scan_span
 from .span import Span, cheap_nbytes
 from .tracer import Tracer, current, export, install, reset, start, stop, suspended
 
 __all__ = [
+    "SCAN_SPAN",
     "Span",
     "Tracer",
     "cache_audit",
     "cheap_nbytes",
     "current",
+    "record_scan_span",
     "export",
     "format_top_spans",
     "install",

@@ -1,0 +1,47 @@
+"""Scan-pipeline spans: tracer schema for pipelined out-of-core scans.
+
+One ``scan.pipeline`` span per :func:`~keystone_tpu.data.pipeline_scan.
+scan_pipeline` scan, covering the whole iteration (first chunk requested
+to exhaustion or early close), with the pipeline's counters as span
+attrs: host production seconds inside the producer thread, producer-stall
+(buffer full — consumer-bound) vs consumer-stall (buffer empty —
+producer-bound) seconds, staged H2D bytes, peak buffer occupancy, and
+chunk count. The overlap a scan achieved is readable straight off the
+span: ``seconds`` ≈ max(producer, consumer) work rather than their sum
+when the pipeline is doing its job, and the stall counters say which side
+bounded it. ``bench.py``'s ``chunk_pipeline`` extra and
+``bin/trace-smoke.sh`` consume these spans.
+"""
+
+from __future__ import annotations
+
+from .span import Span
+from .tracer import current
+
+#: the span name every pipelined scan records
+SCAN_SPAN = "scan.pipeline"
+
+
+def record_scan_span(stats) -> None:
+    """Record one finished scan's counters as a complete span. No-op when
+    tracing is off (the usual single ``current() is None`` check)."""
+    tracer = current()
+    if tracer is None:
+        return
+    sp = Span(
+        name=SCAN_SPAN,
+        start=stats.start,
+        end=stats.end,
+        op_type="ScanPipeline",
+        attrs={
+            "label": stats.label,
+            "chunks": stats.chunks,
+            "depth": stats.depth,
+            "producer_seconds": round(stats.producer_seconds, 6),
+            "producer_stall_seconds": round(stats.producer_stall_seconds, 6),
+            "consumer_stall_seconds": round(stats.consumer_stall_seconds, 6),
+            "staged_bytes": stats.staged_bytes,
+            "occupancy_max": stats.occupancy_max,
+        },
+    )
+    tracer.record_complete(sp)

@@ -151,10 +151,19 @@ class FusedTransformerOperator(TransformerOperator):
                     "per-chunk: batch statistics would be computed per "
                     "chunk — materialize the dataset first"
                 )
+            # shape-bucket ragged (tail) chunks: pad up to a small static
+            # ladder derived from the lead chunk and slice the pad off the
+            # result, so the fused program compiles once per bucket instead
+            # of once per distinct chunk shape (serving/batching.py's trick
+            # applied to out-of-core scans). The padder is captured by the
+            # lazy factory, so lineage re-scans reuse the same compiles.
+            from ..data.pipeline_scan import ChunkPadder
+
+            fn = self._jitted()
             if len(datasets) == 1:
-                return datasets[0].map_batch(lambda x: self._jitted()(x))
+                return datasets[0].map_batch(ChunkPadder(fn))
             zipped = align_and_zip(datasets)
-            return zipped.map_batch(lambda t: self._jitted()(*t))
+            return zipped.map_batch(ChunkPadder(lambda t: fn(*t)))
         if all(ds.is_batched for ds in datasets):
             arrays = [ds.to_array() for ds in datasets]
             return Dataset(self._jitted()(*arrays), batched=True)

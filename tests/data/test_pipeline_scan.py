@@ -1,0 +1,351 @@
+"""Pipelined scan runtime: ordering, bounded buffering, failure modes
+(producer-exception propagation, early-exit join), kill switch, shape
+bucketing, and the streaming consumers routed through it
+(data/pipeline_scan.py)."""
+
+import threading
+import time
+import traceback
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import ChunkedDataset, scan_pipeline
+from keystone_tpu.data.pipeline_scan import (
+    ChunkPadder,
+    ScanPipeline,
+    bucket_ladder,
+    payload_nbytes,
+)
+
+
+def _chunks(n=7, rows=5, d=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((rows, d)).astype(np.float32) for _ in range(n)]
+
+
+def _scan_threads():
+    return [t for t in threading.enumerate() if t.name.startswith("ks-scan")]
+
+
+# -- core pipeline contract --------------------------------------------------
+
+
+def test_order_and_content_preserved_under_slow_consumer():
+    chunks = _chunks(9)
+    it = scan_pipeline(iter(chunks), depth=2, label="t")
+    out = []
+    for c in it:
+        time.sleep(0.005)  # slow consumer: producer fills the buffer
+        out.append(np.asarray(c))
+    assert len(out) == len(chunks)
+    for got, want in zip(out, chunks):
+        np.testing.assert_array_equal(got, want)
+    assert not _scan_threads()  # producer joined at exhaustion
+
+
+def test_bounded_buffer_never_exceeds_depth():
+    depth = 2
+    state = {"produced": 0, "consumed": 0, "max_ahead": 0}
+
+    def source():
+        for c in _chunks(12):
+            state["produced"] += 1
+            ahead = state["produced"] - state["consumed"]
+            state["max_ahead"] = max(state["max_ahead"], ahead)
+            yield c
+
+    it = scan_pipeline(source(), depth=depth, label="t")
+    for _ in it:
+        state["consumed"] += 1
+        time.sleep(0.01)  # slow consumer forces maximal readahead
+    # lookahead bound: queue (depth) + staging ring (depth) + the chunk in
+    # the producer's hand + the one being consumed
+    assert state["max_ahead"] <= 2 * depth + 2, state
+    assert isinstance(it, ScanPipeline)
+    assert it.stats.occupancy_max <= depth
+
+
+def test_producer_exception_surfaces_with_original_traceback():
+    def boom_source():
+        yield np.zeros((2, 2), np.float32)
+        raise RuntimeError("chunk 1 exploded")
+
+    it = scan_pipeline(boom_source(), label="t")
+    first = next(it)
+    assert np.asarray(first).shape == (2, 2)
+    with pytest.raises(RuntimeError, match="chunk 1 exploded") as ei:
+        list(it)
+    tb = "".join(traceback.format_exception(ei.type, ei.value, ei.tb))
+    assert "boom_source" in tb  # the producer frame is in the traceback
+    assert not _scan_threads()
+
+
+def test_early_consumer_exit_joins_producer_within_timeout():
+    def slow_source():
+        for c in _chunks(100):
+            time.sleep(0.001)
+            yield c
+
+    it = scan_pipeline(slow_source(), depth=2, label="t")
+    assert isinstance(it, ScanPipeline)
+    next(it)
+    thread = it._thread
+    assert thread.is_alive()
+    it.close()  # early exit: must drain + join, not deadlock
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    # closed iterator is exhausted, not wedged
+    assert list(it) == []
+
+
+def test_abandoned_iterator_is_reaped_by_gc():
+    it = scan_pipeline(iter(_chunks(50)), label="t")
+    next(it)
+    thread = it._thread
+    del it  # no explicit close: __del__ must join the producer
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+
+
+def test_generator_exit_in_wrapping_generator_does_not_deadlock():
+    def consumer_gen():
+        for c in scan_pipeline(iter(_chunks(50)), label="t"):
+            yield c
+
+    g = consumer_gen()
+    next(g)
+    g.close()  # GeneratorExit unwinds the for loop; pipeline must be reaped
+    deadline = time.monotonic() + 5.0
+    while _scan_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not _scan_threads()
+
+
+def test_kill_switch_disables_thread_but_preserves_results(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_SCAN_PIPELINE", "0")
+    chunks = _chunks(5)
+    before = threading.active_count()
+    it = scan_pipeline(iter(chunks), label="t")
+    assert not isinstance(it, ScanPipeline)
+    out = list(it)
+    assert threading.active_count() == before
+    for got, want in zip(out, chunks):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_scan_pipeline_is_idempotent():
+    it = scan_pipeline(iter(_chunks(3)), label="t")
+    assert scan_pipeline(it) is it
+    list(it)
+
+
+def test_chunked_dataset_scans_through_pipeline_and_matches():
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((41, 4)).astype(np.float32)
+    ds = ChunkedDataset.from_array(X, 8).map_batch(lambda c: c * 2.0)
+    it = ds.chunks()
+    assert isinstance(it, ScanPipeline)
+    it.close()
+    np.testing.assert_allclose(np.asarray(ds.to_array()), X * 2.0, rtol=1e-6)
+    assert not _scan_threads()
+
+
+# -- tracer integration ------------------------------------------------------
+
+
+def test_scan_records_span_with_stall_counters():
+    from keystone_tpu.obs import SCAN_SPAN, Tracer, install
+    from keystone_tpu.obs import tracer as trace_mod
+
+    tracer = install(Tracer())
+    try:
+        ds = ChunkedDataset.from_array(np.ones((20, 3), np.float32), 6)
+        ds.to_array()
+        spans = [sp for sp in tracer.spans() if sp.name == SCAN_SPAN]
+        assert spans, [sp.name for sp in tracer.spans()]
+        sp = spans[-1]
+        assert sp.attrs["chunks"] == 4
+        for key in (
+            "producer_seconds",
+            "producer_stall_seconds",
+            "consumer_stall_seconds",
+            "staged_bytes",
+            "occupancy_max",
+            "depth",
+        ):
+            assert key in sp.attrs
+        assert sp.seconds >= 0.0
+    finally:
+        trace_mod.reset()
+
+
+# -- payload byte accounting -------------------------------------------------
+
+
+def test_payload_nbytes_honors_dtypes():
+    assert payload_nbytes(np.zeros((4, 2), np.float64)) == 64
+    assert payload_nbytes(np.zeros((4, 2), np.int8)) == 8
+    assert payload_nbytes(
+        (np.zeros((2, 2), np.float32), np.zeros((2,), np.float16))
+    ) == 20
+    # leaves without .dtype are measured, not assumed float32
+    assert payload_nbytes([1.0, 2.0]) == 16  # two python floats -> f64
+
+
+# -- shape bucketing ---------------------------------------------------------
+
+
+def test_bucket_ladder_shape():
+    assert bucket_ladder(512) == (64, 128, 256, 512)
+    assert bucket_ladder(1000) == (125, 250, 500, 1000)
+    assert bucket_ladder(1, levels=4) == (1,)
+
+
+def test_chunk_padder_compiles_per_bucket_and_is_exact():
+    calls = []
+
+    def fn(x):
+        calls.append(int(x.shape[0]))
+        return np.asarray(x) + 1.0
+
+    padder = ChunkPadder(fn)
+    sizes = [512, 480, 500, 300, 450, 200]
+    rng = np.random.default_rng(3)
+    for r in sizes:
+        x = rng.standard_normal((r, 4)).astype(np.float32)
+        out = padder(x)
+        assert out.shape == (r, 4)
+        np.testing.assert_allclose(np.asarray(out), x + 1.0, rtol=1e-6)
+    # every call shape is a bucket, and distinct shapes <= ladder size
+    ladder = set(bucket_ladder(512))
+    assert set(calls) <= ladder
+    assert len(set(calls)) <= len(ladder)
+    assert len(set(calls)) < len(set(sizes))  # strictly fewer than raw shapes
+
+
+def test_chunk_padder_kill_switch(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_CHUNK_BUCKETS", "0")
+    shapes = []
+
+    def fn(x):
+        shapes.append(int(x.shape[0]))
+        return x
+
+    padder = ChunkPadder(fn)
+    padder(np.zeros((512, 2), np.float32))
+    padder(np.zeros((300, 2), np.float32))
+    assert shapes == [512, 300]  # pass-through, no padding
+
+
+def test_fused_chain_over_ragged_chunks_buckets_compiles():
+    """End-to-end: a fused 2-node chain over a ragged chunked scan traces
+    once per bucket (trace-time counter), not once per distinct shape,
+    and the output is exact."""
+    from keystone_tpu.workflow.transformer import FunctionNode
+
+    sizes = [64, 60, 62, 40, 25, 64]
+    total = sum(sizes)
+    rng = np.random.default_rng(11)
+    parts = [rng.standard_normal((r, 5)).astype(np.float32) for r in sizes]
+
+    def gen(i):
+        return parts[i]
+
+    ds = ChunkedDataset.from_chunk_fn(gen, len(sizes), total)
+    traces = []
+
+    def f1(x):
+        traces.append(int(x.shape[0]))  # runs once per jit trace
+        return x * 2.0
+
+    pipe = FunctionNode(batch_fn=f1).and_then(
+        FunctionNode(batch_fn=lambda x: x + 1.0)
+    )
+    out = pipe.apply(ds).get()
+    got = np.asarray(out.to_array())
+    want = np.concatenate(parts) * 2.0 + 1.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert len(traces) <= len(bucket_ladder(64))
+    assert len(traces) < len(set(sizes))
+
+
+# -- routed consumers --------------------------------------------------------
+
+
+def test_chunked_map_thread_pool_preserves_order(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_MAP_WORKERS", "4")
+    rng = np.random.default_rng(13)
+    X = rng.standard_normal((33, 6)).astype(np.float32)
+    ds = ChunkedDataset.from_array(X, 7)
+    out = ds.map(lambda row: row * 3.0)
+    np.testing.assert_allclose(np.asarray(out.to_array()), X * 3.0, rtol=1e-6)
+    monkeypatch.setenv("KEYSTONE_MAP_WORKERS", "1")
+    out_serial = ds.map(lambda row: row * 3.0)
+    np.testing.assert_allclose(
+        np.asarray(out_serial.to_array()), X * 3.0, rtol=1e-6
+    )
+
+
+def test_standard_scaler_streams_chunked_without_materializing():
+    from keystone_tpu.nodes.stats import StandardScaler
+
+    rng = np.random.default_rng(17)
+    X = rng.standard_normal((57, 4)).astype(np.float32) * 3.0 + 1.0
+    dense = StandardScaler().fit(
+        __import__("keystone_tpu.data", fromlist=["Dataset"]).Dataset(
+            jnp.asarray(X), batched=True
+        )
+    )
+    chunked = StandardScaler().fit(ChunkedDataset.from_array(X, 9))
+    np.testing.assert_allclose(
+        np.asarray(chunked.mean), np.asarray(dense.mean), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunked.std), np.asarray(dense.std), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_standard_scaler_streaming_survives_large_mean_small_var():
+    """The one-pass E[x²]−mean² form cancels catastrophically in f32 at
+    |mean| ≫ std (std silently became 1.0); the Chan/Welford chunk merge
+    must recover the real std."""
+    from keystone_tpu.nodes.stats import StandardScaler
+
+    rng = np.random.default_rng(29)
+    X = (rng.standard_normal((64, 3)) * 0.01 + 1000.0).astype(np.float32)
+    model = StandardScaler().fit(ChunkedDataset.from_array(X, 9))
+    want = X.astype(np.float64).std(axis=0, ddof=1)
+    np.testing.assert_allclose(np.asarray(model.std), want, rtol=0.05)
+
+
+def test_streaming_solver_still_exact_through_pipeline():
+    """The BCD streaming solver (routed through scan_pipeline) matches the
+    in-memory block solve."""
+    from keystone_tpu.linalg import (
+        solve_blockwise_l2,
+        solve_blockwise_l2_streaming,
+    )
+
+    rng = np.random.default_rng(23)
+    n, d, bs, k = 96, 8, 4, 3
+    A = rng.standard_normal((n, d)).astype(np.float32)
+    y = rng.standard_normal((n, k)).astype(np.float32)
+
+    def scan():
+        for i in range(0, n, 32):
+            yield A[i : i + 32]
+
+    ws = solve_blockwise_l2_streaming(
+        scan, jnp.asarray(y), reg=1e-2, block_size=bs
+    )
+    blocks = [jnp.asarray(A[:, i : i + bs]) for i in range(0, d, bs)]
+    ws_ref = solve_blockwise_l2(blocks, jnp.asarray(y), reg=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate(ws, axis=0)),
+        np.asarray(jnp.concatenate(ws_ref, axis=0)),
+        rtol=1e-3,
+        atol=1e-4,
+    )
+    assert not _scan_threads()
