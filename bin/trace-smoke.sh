@@ -8,6 +8,9 @@
 # counters) land in the trace. Exits non-zero on any failure. Extra
 # flags pass through to the pipeline, e.g.:
 #   bin/trace-smoke.sh /tmp/trace.json --numFFTs 4
+# A third stage runs a host-bound gather pipeline under the concurrent
+# executor and asserts the scheduled node spans carry queue_wait_seconds /
+# worker attribution and still nest under the pull root.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
@@ -66,4 +69,54 @@ for key in (
     assert key in args, (key, args)
 assert args["chunks"] == 8  # ceil(64/9)
 print(f"SCAN SPANS OK: {len(scans)} scan.pipeline span(s) -> {path}")
+PY
+
+# -- concurrent-executor spans -----------------------------------------------
+par_out="$(mktemp /tmp/keystone-par-trace-XXXXXX.json)"
+env JAX_PLATFORMS=cpu KEYSTONE_TRACE="$par_out" KEYSTONE_EXEC_WORKERS=2 \
+  python - "$par_out" <<'PY'
+import json
+import sys
+import time
+
+import numpy as np
+
+from keystone_tpu.utils.obs import configure, export_trace
+
+configure()
+
+from keystone_tpu.workflow.pipeline import Pipeline
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def mk(i):
+    def feat(x):
+        time.sleep(0.005)  # host-stall stand-in; forces real overlap
+        return np.asarray(x) * (i + 1.0)
+
+    return FunctionNode(item_fn=feat, label=f"host{i}")
+
+
+Pipeline.gather([mk(i) for i in range(4)]).apply(
+    np.ones((3, 4), np.float32)
+).get()
+path = export_trace()
+assert path == sys.argv[1], (path, sys.argv[1])
+with open(path) as f:
+    doc = json.load(f)
+events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+sched = [e for e in events if "queue_wait_seconds" in e.get("args", {})]
+assert len(sched) >= 2, "no scheduler-attributed executor spans"
+for e in sched:
+    assert str(e["args"]["worker"]).startswith("keystone-exec"), e["args"]
+    assert e["args"]["queue_wait_seconds"] >= 0.0, e["args"]
+pull = [e for e in events if e["name"] == "pipeline.pull"]
+assert len(pull) == 1, [e["name"] for e in events]
+lo, hi = pull[0]["ts"], pull[0]["ts"] + pull[0]["dur"]
+for e in sched:
+    # the span tree still nests: scheduled node spans (worker threads) sit
+    # inside the pull root opened on the caller thread
+    assert lo <= e["ts"] and e["ts"] + e["dur"] <= hi + 1000.0, (e, pull[0])
+    assert e["tid"] != pull[0]["tid"], e
+print(f"PAR SPANS OK: {len(sched)} scheduled node span(s) -> {path}")
 PY

@@ -221,11 +221,67 @@ class ChunkedDataset(Dataset):
             for i in range(rows):
                 yield jax.tree_util.tree_map(lambda a: a[i], chunk)
 
+    def take(self, n: int) -> Dataset:
+        """The first ``n`` rows, materialized from a raw leading-chunk peek:
+        no producer thread, no staged readahead, and the scan stops at the
+        first chunk that completes ``n`` rows — a 24-item optimizer sample
+        of a million-row chunked set pays for one chunk, not the dataset."""
+        if n < 0:
+            raise ValueError("take of a negative count")
+        parts: List[Any] = []
+        rows = 0
+        it = self.raw_chunks()
+        try:
+            while rows < n:
+                chunk = next(it, None)
+                if chunk is None:
+                    break
+                need = n - rows
+                got = _payload_rows(chunk)
+                if got > need:
+                    chunk = jax.tree_util.tree_map(lambda a: a[:need], chunk)
+                    got = need
+                parts.append(chunk)
+                rows += got
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+        if not parts:
+            if n == 0:
+                peek = self.raw_chunks()
+                try:
+                    chunk = next(peek, None)
+                finally:
+                    close = getattr(peek, "close", None)
+                    if close is not None:
+                        close()
+                if chunk is not None:
+                    return Dataset(
+                        jax.tree_util.tree_map(lambda a: a[:0], chunk),
+                        batched=True,
+                    )
+            # parity with Dataset.take on an empty payload: an empty
+            # dataset back, never an exception
+            return Dataset([], batched=False)
+        if len(parts) == 1:
+            payload = parts[0]
+        else:
+            import jax.numpy as jnp
+
+            payload = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *parts
+            )
+        return Dataset(payload, batched=True)
+
     def first(self) -> Any:
-        # one chunk of a raw scan: no producer thread, no staged readahead
-        # — first() must not pay depth chunks of production for one row
-        chunk = next(self.raw_chunks())
-        return jax.tree_util.tree_map(lambda a: a[0], chunk)
+        # one row off the take(1) peek — same raw one-chunk scan; first()
+        # must not pay depth chunks of production for one row
+        head = self.take(1)
+        if len(head) == 0:
+            # same exception family as Dataset.first on an empty list
+            raise IndexError("first() of an empty chunked dataset")
+        return jax.tree_util.tree_map(lambda a: a[0], head.payload)
 
     def to_array(self):
         """Materialize by concatenating every chunk (small results only —

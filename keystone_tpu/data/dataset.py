@@ -82,6 +82,20 @@ class Dataset:
             return jax.tree_util.tree_map(lambda a: a[0], self._payload)
         return self._payload[0]
 
+    def take(self, n: int) -> "Dataset":
+        """The first ``n`` items as a dataset, WITHOUT materializing the
+        rest: batched payloads are sliced views (no per-item unstacking,
+        unlike ``collect()[:n]``), item lists slice the list. Sampling
+        paths (node optimization, profiling) go through here."""
+        if n < 0:
+            raise ValueError("take of a negative count")
+        if self._batched:
+            return Dataset(
+                jax.tree_util.tree_map(lambda a: a[:n], self._payload),
+                batched=True,
+            )
+        return Dataset(self._payload[:n], batched=False)
+
     def collect(self) -> List[Any]:
         """Materialize as a list of per-item values (host)."""
         return list(self)

@@ -52,15 +52,10 @@ from typing import Any, Callable, Iterator, Optional, Tuple
 import jax
 import numpy as np
 
+from ..utils import env_flag as _env_flag, env_int as _env_int
+
 DEFAULT_DEPTH = 2
 _JOIN_TIMEOUT = 5.0
-
-
-def _env_flag(name: str, default: bool = True) -> bool:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    return raw.strip().lower() not in ("0", "false", "no", "off")
 
 
 def pipeline_enabled() -> bool:
@@ -75,24 +70,14 @@ def bucketing_enabled() -> bool:
 
 
 def pipeline_depth() -> int:
-    try:
-        depth = int(os.environ.get("KEYSTONE_SCAN_DEPTH", DEFAULT_DEPTH))
-    except ValueError:
-        depth = DEFAULT_DEPTH
-    return max(1, depth)
+    return _env_int("KEYSTONE_SCAN_DEPTH", DEFAULT_DEPTH)
 
 
 def map_workers() -> int:
     """Pool size for ChunkedDataset.map's per-item fallback. Default
     min(4, cores): the per-item fns are host featurizers whose numpy work
     releases the GIL; 1 disables the pool."""
-    raw = os.environ.get("KEYSTONE_MAP_WORKERS")
-    if raw is not None:
-        try:
-            return max(1, int(raw))
-        except ValueError:
-            pass
-    return min(4, os.cpu_count() or 1)
+    return _env_int("KEYSTONE_MAP_WORKERS", min(4, os.cpu_count() or 1))
 
 
 def payload_rows(payload: Any) -> int:

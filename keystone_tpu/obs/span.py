@@ -46,6 +46,9 @@ class Span:
     #: XLA backend compiles that happened inside this span
     compiles: int = 0
     instant: bool = False
+    #: free-form attributes; the concurrent executor adds
+    #: ``queue_wait_seconds`` (ready-to-started scheduler latency) and
+    #: ``worker`` (pool thread name) to node spans it forced
     attrs: Dict[str, Any] = field(default_factory=dict)
     #: value to block on at span exit (cleared once synced); not exported
     sync_target: Any = field(default=None, repr=False)

@@ -96,6 +96,30 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
+    def current_span(self) -> Optional[Span]:
+        """This thread's innermost open span, or None. The concurrent
+        executor captures it as the explicit parent for worker threads."""
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    @contextlib.contextmanager
+    def adopt(self, parent: Optional[Span]) -> Iterator[None]:
+        """Explicit cross-thread parent linking: make ``parent`` (a span
+        opened on ANOTHER thread) the current parent on THIS thread. The
+        per-thread stacks give a correct tree only for same-thread nesting;
+        a scheduler worker forcing a DAG node starts with an empty stack,
+        so without adoption its node spans would all be roots. ``parent``
+        is pushed but never recorded here — its opener owns its exit."""
+        if parent is None:
+            yield
+            return
+        stack = self._stack()
+        stack.append(parent)
+        try:
+            yield
+        finally:
+            stack.pop()
+
     @contextlib.contextmanager
     def span(
         self,

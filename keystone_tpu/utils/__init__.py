@@ -1,3 +1,28 @@
+import os
+
 from .serialization import load_pickle, save_pickle
 
-__all__ = ["load_pickle", "save_pickle"]
+__all__ = ["env_flag", "env_int", "load_pickle", "save_pickle"]
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """Shared truthy parsing for KEYSTONE_* switch env vars, so every knob
+    (KEYSTONE_SCAN_PIPELINE, KEYSTONE_PAR_EXEC, ...) accepts the same
+    spellings: unset -> ``default``; 0/false/no/off (any case) -> False."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+def env_int(name: str, default: int, minimum: int = 1) -> int:
+    """Shared integer parsing for KEYSTONE_* sizing env vars (worker
+    counts, depths): unset or unparsable -> ``default``; parsed values are
+    clamped to ``minimum``."""
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return max(minimum, int(raw))
+        except ValueError:
+            pass
+    return default

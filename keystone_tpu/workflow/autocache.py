@@ -68,7 +68,15 @@ def _result_bytes(value) -> float:
 
 def _profile_at_scale(graph: Graph, sample_size: int) -> Dict[NodeId, Profile]:
     sampled = _sampled_graph(graph, sample_size)
-    executor = GraphExecutor(sampled, optimize=False)
+    # parallel=False: the fitted time-vs-scale model needs each node's own
+    # wall-clock — sibling branches running on other cores during a timed
+    # pull would inflate (contention) or hide (overlap) per-node cost.
+    # Production pulls still run concurrently; retention is unchanged
+    # (uncached intermediates stay in the per-pull transient table and the
+    # scheduler drops each expression as it completes), though peak
+    # transient memory under concurrency can reach worker-count in-flight
+    # branches' intermediates at once — KEYSTONE_EXEC_WORKERS bounds it.
+    executor = GraphExecutor(sampled, optimize=False, parallel=False)
     profiles: Dict[NodeId, Profile] = {}
     # profiling pulls run at sampled scale over a TRUNCATED graph whose
     # node ids collide with the production graph's — suspend tracing so

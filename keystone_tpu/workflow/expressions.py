@@ -4,10 +4,19 @@ Parity target: ``workflow/Expression.scala`` in the reference. An ``Expression``
 wraps a thunk evaluated at most once; laziness is what lets the optimizer
 rewrite the graph before anything executes, and memoization is what makes the
 pull-based executor cheap to re-enter.
+
+Forcing is thread-safe: the concurrent executor (``executor.py``) hands
+independent branches of one pull to a worker pool, and a diamond dependency
+means two workers can reach the same expression at once — the per-expression
+once-latch guarantees the thunk still runs exactly once, with every other
+thread blocking until the value exists. Lock order follows dependency order
+(a thunk only forces its own dependencies), so the acyclic graph cannot
+deadlock.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, TYPE_CHECKING
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -18,20 +27,25 @@ _UNSET = object()
 
 
 class Expression:
-    """A call-by-name, memoized value."""
+    """A call-by-name, memoized value with a thread-safe once-latch."""
 
     def __init__(self, thunk: Callable[[], Any]):
         self._thunk = thunk
         self._value: Any = _UNSET
+        self._latch = threading.Lock()
 
     @property
     def computed(self) -> bool:
         return self._value is not _UNSET
 
     def get(self) -> Any:
+        # lock-free fast path: a computed value never un-computes, and the
+        # CPython assignment under the latch publishes it atomically
         if self._value is _UNSET:
-            self._value = self._thunk()
-            self._thunk = None  # release captured state
+            with self._latch:
+                if self._value is _UNSET:
+                    self._value = self._thunk()
+                    self._thunk = None  # release captured state
         return self._value
 
     def map_thunk(self, wrap: Callable[[Callable[[], Any]], Callable[[], Any]]) -> None:
@@ -39,14 +53,26 @@ class Expression:
         computed. This is how the tracing executor attributes wall-clock to
         the node that actually COMPUTES (evaluation is lazy — timing
         ``Operator.execute`` would only measure thunk construction)."""
-        if self._value is _UNSET:
-            self._thunk = wrap(self._thunk)
+        with self._latch:
+            if self._value is _UNSET:
+                self._thunk = wrap(self._thunk)
 
     @staticmethod
     def now(value: Any) -> "Expression":
         e = Expression(lambda: value)
         e.get()
         return e
+
+    # locks don't pickle; only computed expressions are serializable anyway
+    # (pending thunks are closures), so drop and rebuild the latch
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_latch"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._latch = threading.Lock()
 
 
 class DatasetExpression(Expression):

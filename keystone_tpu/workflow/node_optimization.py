@@ -12,8 +12,6 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional, Sequence, Tuple
 
-import jax
-
 from ..data.dataset import Dataset
 from .executor import GraphExecutor
 from .graph import Graph, NodeId
@@ -46,14 +44,12 @@ def _sampled_graph(graph: Graph, sample_size: int) -> Graph:
         if isinstance(op, DatasetOperator):
             ds = op.dataset
             if len(ds) > sample_size:
-                if ds.is_batched:
-                    sampled = Dataset(
-                        jax.tree_util.tree_map(lambda a: a[:sample_size], ds.payload),
-                        batched=True,
-                    )
-                else:
-                    sampled = Dataset.from_items(ds.collect()[:sample_size])
-                graph = graph.set_operator(node, DatasetOperator(sampled))
+                # take() slices lazily (and peeks only the leading chunks of
+                # a ChunkedDataset) — the previous collect()[:n] unstacked
+                # the ENTIRE dataset into per-item rows to sample 24 of them
+                graph = graph.set_operator(
+                    node, DatasetOperator(ds.take(sample_size))
+                )
     return graph
 
 
@@ -82,8 +78,10 @@ class NodeOptimizationRule(Rule):
         if not optimizable:
             return graph, annotations
 
+        # sampled-scale pulls stay serial: they exist to be cheap, and the
+        # concurrent scheduler's pool would only add noise at 24 items
         sampled = _sampled_graph(graph, self.sample_size)
-        executor = GraphExecutor(sampled, optimize=False)
+        executor = GraphExecutor(sampled, optimize=False, parallel=False)
         for node in optimizable:
             op = graph.get_operator(node)
             deps = graph.get_dependencies(node)

@@ -87,7 +87,9 @@ class PipelineResult:
         tracer = _trace_current()
         if tracer is None:
             return self.expression().get()
-        # the pull root: every node span of this execution nests under it
+        # the pull root: every node span of this execution nests under it —
+        # including spans from scheduler worker threads, which the executor
+        # explicitly links under this thread's open span (Tracer.adopt)
         with tracer.span("pipeline.pull", op_type=type(self).__name__) as sp:
             value = self.expression().get()
             sp.sync_on(value)
@@ -268,7 +270,13 @@ class Pipeline(Chainable):
         """Fit every estimator NOW and return a serializable transformer-only
         pipeline (parity: ``Pipeline.scala:38-65``). This is the jit boundary:
         the returned :class:`FittedPipeline` contains no estimators and can be
-        compiled to a single XLA computation."""
+        compiled to a single XLA computation.
+
+        Fit-time featurization rides the concurrent executor: each
+        estimator pull below goes through ``GraphExecutor.execute``, so the
+        N gather branches feeding an estimator featurize on the worker pool
+        (``KEYSTONE_EXEC_WORKERS``) exactly as ``apply`` does —
+        ``KEYSTONE_PAR_EXEC=0`` serializes both."""
         tracer = _trace_current()
         if tracer is None:
             return self._fit()

@@ -1,0 +1,326 @@
+"""Concurrent DAG executor (workflow/executor.py): dependency-scheduled
+branch parallelism. Covers parallel-vs-serial output equality on the real
+gather pipelines (mnist_random_fft, timit featurizers), exactly-once diamond
+computation under contention, branch-exception propagation with sibling
+cancellation, the ``KEYSTONE_PAR_EXEC=0`` kill switch, and queue-wait /
+worker span attribution with explicit cross-thread parent linking."""
+
+import threading
+import time
+import traceback
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.obs import tracer as trace_mod
+from keystone_tpu.pipelines.mnist_random_fft import (
+    MnistRandomFFTConfig,
+    build_featurizer as build_mnist_featurizer,
+)
+from keystone_tpu.pipelines.timit import (
+    TimitConfig,
+    build_featurizer as build_timit_featurizer,
+)
+from keystone_tpu.workflow.env import PipelineEnv
+from keystone_tpu.workflow.pipeline import Pipeline
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def _run(pipeline_factory, data, monkeypatch, parallel, workers=2):
+    """Apply a freshly-built pipeline with the executor mode pinned.
+
+    A fresh build per run (plus a PipelineEnv reset) keeps the two modes
+    honest: saved-state prefixes from the first application must not hand
+    the second one precomputed results."""
+    PipelineEnv.get_or_create().reset()
+    monkeypatch.setenv("KEYSTONE_PAR_EXEC", "1" if parallel else "0")
+    monkeypatch.setenv("KEYSTONE_EXEC_WORKERS", str(workers))
+    out = pipeline_factory().apply(data).get()
+    return np.asarray(out.to_array())
+
+
+# ---------------------------------------------------------------------------
+# parallel-vs-serial equality on the real gather pipelines
+# ---------------------------------------------------------------------------
+
+
+def test_mnist_random_fft_gather_parallel_matches_serial(monkeypatch):
+    conf = MnistRandomFFTConfig(num_ffts=4, seed=3)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((16, 784)).astype(np.float32)
+    serial = _run(lambda: build_mnist_featurizer(conf), X, monkeypatch, False)
+    parallel = _run(lambda: build_mnist_featurizer(conf), X, monkeypatch, True)
+    assert serial.shape[0] == 16 and serial.shape[1] % 4 == 0
+    np.testing.assert_array_equal(serial, parallel)
+
+
+def test_timit_gather_parallel_matches_serial(monkeypatch):
+    conf = TimitConfig(num_cosines=3, input_dim=64, cosine_features=32)
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((12, 64)).astype(np.float32)
+    serial = _run(lambda: build_timit_featurizer(conf), X, monkeypatch, False)
+    parallel = _run(lambda: build_timit_featurizer(conf), X, monkeypatch, True)
+    assert serial.shape == (12, 3 * 32)
+    np.testing.assert_array_equal(serial, parallel)
+
+
+# ---------------------------------------------------------------------------
+# host-bound branches genuinely overlap
+# ---------------------------------------------------------------------------
+
+
+def _host_branch(label, record=None, stall=0.0, boom=False):
+    """An UNTRACEABLE per-item branch (no trace_batch): fusion cannot
+    collapse it, so it stays a distinct DAG node forced on the pool."""
+
+    def feat(x):
+        if record is not None:
+            record.append((label, threading.current_thread().name))
+        if boom:
+            raise RuntimeError(f"boom in {label}")
+        if stall:
+            time.sleep(stall)
+        return np.asarray(x) * 2.0
+
+    return FunctionNode(item_fn=feat, label=label)
+
+
+def test_host_branches_use_multiple_workers(monkeypatch):
+    record = []
+    X = np.ones((3, 4), np.float32)
+    out = _run(
+        lambda: Pipeline.gather(
+            [_host_branch(f"b{i}", record, stall=0.02) for i in range(4)]
+        ),
+        X,
+        monkeypatch,
+        parallel=True,
+        workers=2,
+    )
+    threads = {t for _, t in record}
+    assert len(threads) >= 2, threads
+    assert all(t.startswith("keystone-exec") for t in threads), threads
+    serial = _run(
+        lambda: Pipeline.gather(
+            [_host_branch(f"b{i}", stall=0.0) for i in range(4)]
+        ),
+        X,
+        monkeypatch,
+        parallel=False,
+    )
+    np.testing.assert_array_equal(np.asarray(out), serial)
+
+
+def test_kill_switch_keeps_everything_on_the_calling_thread(monkeypatch):
+    record = []
+    X = np.ones((3, 4), np.float32)
+    _run(
+        lambda: Pipeline.gather(
+            [_host_branch(f"b{i}", record) for i in range(4)]
+        ),
+        X,
+        monkeypatch,
+        parallel=False,
+    )
+    threads = {t for _, t in record}
+    assert threads == {threading.current_thread().name}
+
+
+# ---------------------------------------------------------------------------
+# diamonds compute exactly once under contention
+# ---------------------------------------------------------------------------
+
+
+def test_diamond_computes_exactly_once_under_contention(monkeypatch):
+    calls = []
+    lock = threading.Lock()
+
+    def shared_fn(x):
+        with lock:
+            calls.append(threading.current_thread().name)
+        time.sleep(0.01)
+        return np.asarray(x) + 1.0
+
+    # ONE shared instance fanned into every branch: CSE merges the four
+    # structurally-identical nodes into a diamond apex whose expression
+    # all branches race to force
+    shared = FunctionNode(item_fn=shared_fn, label="shared")
+    n_items = 3
+    X = np.ones((n_items, 4), np.float32)
+    out = _run(
+        lambda: Pipeline.gather(
+            [
+                shared.and_then(_host_branch(f"b{i}", stall=0.01))
+                for i in range(4)
+            ]
+        ),
+        X,
+        monkeypatch,
+        parallel=True,
+        workers=4,
+    )
+    # once per item of ONE pass — a re-computed diamond would double this
+    assert len(calls) == n_items, calls
+    # gather payload: one (n_items, 4) array per branch, all (x+1)*2 = 4
+    np.testing.assert_array_equal(
+        np.asarray(out), np.full((4, n_items, 4), 4.0, np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# failure semantics
+# ---------------------------------------------------------------------------
+
+
+def test_branch_exception_propagates_and_cancels_unstarted_siblings(
+    monkeypatch,
+):
+    record = []
+    X = np.ones((2, 4), np.float32)
+
+    def build():
+        # boom is branch 0 — first in topological submission order; with
+        # one worker it fails before any sibling is submitted
+        return Pipeline.gather(
+            [_host_branch("boom", boom=True)]
+            + [_host_branch(f"b{i}", record) for i in range(1, 4)]
+        )
+
+    with pytest.raises(RuntimeError, match="boom in boom") as excinfo:
+        _run(build, X, monkeypatch, parallel=True, workers=1)
+    # original traceback survives the scheduler hop: the raising frame
+    # (the branch's item fn) is visible to the caller
+    frames = [
+        f.name for f in traceback.extract_tb(excinfo.value.__traceback__)
+    ]
+    assert "feat" in frames, frames
+    assert record == [], f"cancelled siblings still ran: {record}"
+
+
+def test_branch_exception_propagates_with_concurrent_siblings(monkeypatch):
+    # with a wide pool the failure must still surface (siblings may run)
+    X = np.ones((2, 4), np.float32)
+
+    def build():
+        return Pipeline.gather(
+            [_host_branch(f"b{i}", stall=0.01) for i in range(3)]
+            + [_host_branch("boom", boom=True)]
+        )
+
+    with pytest.raises(RuntimeError, match="boom"):
+        _run(build, X, monkeypatch, parallel=True, workers=4)
+
+
+# ---------------------------------------------------------------------------
+# span attribution: queue wait, worker identity, cross-thread parenting
+# ---------------------------------------------------------------------------
+
+
+def test_scheduled_node_spans_carry_queue_wait_and_nest_under_pull(
+    monkeypatch,
+):
+    trace_mod.reset()
+    tracer = trace_mod.install(trace_mod.Tracer())
+    try:
+        X = np.ones((3, 4), np.float32)
+        _run(
+            lambda: Pipeline.gather(
+                [_host_branch(f"b{i}", stall=0.01) for i in range(4)]
+            ),
+            X,
+            monkeypatch,
+            parallel=True,
+            workers=2,
+        )
+        spans = tracer.spans()
+        by_id = {sp.span_id: sp for sp in spans}
+        # well-formed tree: every parent id resolves
+        assert all(
+            sp.parent_id is None or sp.parent_id in by_id for sp in spans
+        )
+        pull = [sp for sp in spans if sp.name == "pipeline.pull"]
+        assert len(pull) == 1
+        scheduled = [
+            sp for sp in spans if sp.attrs.get("worker") is not None
+        ]
+        assert len(scheduled) >= 2, [sp.name for sp in spans]
+        for sp in scheduled:
+            assert sp.attrs["queue_wait_seconds"] >= 0.0
+            assert sp.attrs["worker"].startswith("keystone-exec")
+            # cross-thread parent linking: the worker's node span nests
+            # under the pull root opened on the caller thread
+            assert sp.parent_id == pull[0].span_id
+            assert sp.tid != pull[0].tid
+    finally:
+        trace_mod.reset()
+
+
+def test_serial_pull_has_no_scheduler_attrs(monkeypatch):
+    trace_mod.reset()
+    tracer = trace_mod.install(trace_mod.Tracer())
+    try:
+        X = np.ones((3, 4), np.float32)
+        _run(
+            lambda: Pipeline.gather(
+                [_host_branch(f"b{i}") for i in range(2)]
+            ),
+            X,
+            monkeypatch,
+            parallel=False,
+        )
+        assert all(
+            sp.attrs.get("worker") is None for sp in tracer.spans()
+        )
+    finally:
+        trace_mod.reset()
+
+
+# ---------------------------------------------------------------------------
+# Dataset.take (the sampling path the optimizer's profiling pulls use)
+# ---------------------------------------------------------------------------
+
+
+def test_take_batched_slices_without_unstacking():
+    ds = Dataset.of(np.arange(40, dtype=np.float32).reshape(10, 4))
+    t = ds.take(3)
+    assert t.is_batched and len(t) == 3
+    np.testing.assert_array_equal(
+        np.asarray(t.payload), np.arange(12, dtype=np.float32).reshape(3, 4)
+    )
+
+
+def test_take_items_slices_the_list():
+    ds = Dataset.from_items(["a", "b", "c", "d"])
+    assert ds.take(2).collect() == ["a", "b"]
+    assert ds.take(0).collect() == []
+
+
+def test_chunked_take_peeks_only_leading_chunks():
+    produced = []
+
+    def factory():
+        for i in range(5):
+            produced.append(i)
+            yield np.full((4, 2), float(i), np.float32)
+
+    ds = ChunkedDataset(factory, 20)
+    t = ds.take(6)  # 4 + 2 rows -> exactly two chunks produced
+    assert len(t) == 6
+    assert produced == [0, 1], produced
+    np.testing.assert_array_equal(
+        np.asarray(t.payload)[:, 0], [0, 0, 0, 0, 1, 1]
+    )
+    produced.clear()
+    assert float(np.asarray(ds.first())[0]) == 0.0
+    assert produced == [0], produced
+
+
+def test_chunked_take_and_first_empty_parity():
+    empty = ChunkedDataset(lambda: iter(()), 0)
+    # parity with Dataset.take on an empty payload: empty dataset, no raise
+    assert len(empty.take(0)) == 0
+    assert len(empty.take(5)) == 0
+    with pytest.raises(IndexError):
+        empty.first()
