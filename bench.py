@@ -2115,6 +2115,74 @@ def bench_gather_parallel() -> dict:
     }
 
 
+def bench_serve_cold_start() -> dict:
+    """AOT executable cache (keystone_tpu/compile/): boot a serving engine
+    in a FRESH subprocess twice against one cache directory and compare
+    warm-up cost. The first boot traces + exports every bucket (cold);
+    the second must load every bucket's executable — ZERO pipeline
+    traces — and be measurably faster. Companion to the ``compile_cache``
+    cold/warm field in the mnist section: that reports the jax XLA-cache
+    layer's state for THIS process; this measures what the AOT layer on
+    top of it buys a new process.
+
+    Subprocesses run on the CPU backend regardless of the parent's
+    backend — two processes cannot own one TPU, and the probe measures
+    host-side trace-vs-load cost, which is backend-independent. Both
+    cache layers (AOT entries + the layered jax compilation cache) root
+    in a throwaway dir, so "cold" is genuinely cold."""
+    import json as _json
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    cache = tempfile.mkdtemp(prefix="keystone-aot-bench-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KEYSTONE_COMPILE_CACHE"] = os.path.join(cache, "xla")
+
+    def boot() -> dict:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "keystone_tpu.compile.coldstart",
+                "--cache", cache, "--numFFTs", "6", "--buckets", "8,32",
+            ],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"coldstart probe failed (rc={proc.returncode}): "
+                + proc.stderr[-2000:]
+            )
+        return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+    try:
+        cold = boot()
+        warm = boot()
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+    speedup = cold["warmup_seconds"] / max(warm["warmup_seconds"], 1e-9)
+    return {
+        "cold": cold,
+        "warm": warm,
+        "warmup_speedup_warm_vs_cold": round(speedup, 2),
+        "warm_zero_traces_ok": bool(
+            warm["compiles"] == 0
+            and warm["aot_loads"] == len(warm["buckets"])
+        ),
+        "outputs_bit_equal_ok": bool(
+            cold["outputs_match"] and warm["outputs_match"]
+        ),
+        "warm_faster_ok": bool(
+            warm["warmup_seconds"] < cold["warmup_seconds"]
+        ),
+        "knobs": (
+            "KEYSTONE_AOT_CACHE=<dir> / --aot-cache install the executable "
+            "cache; KEYSTONE_AOT_CACHE_BYTES bounds it (LRU)"
+        ),
+    }
+
+
 def _section(name, fn):
     """Run one bench section with stderr progress (stdout stays pure JSON)."""
     import sys
@@ -2145,6 +2213,7 @@ def main() -> int:
     voc = _section("voc", bench_voc_real_codebook)
     chunk_pipeline = _section("chunk_pipeline", bench_chunk_pipeline)
     gather_parallel = _section("gather_parallel", bench_gather_parallel)
+    serve_cold_start = _section("serve_cold_start", bench_serve_cold_start)
     weak_scaling = _section("weak_scaling", bench_weak_scaling)
     from keystone_tpu.obs import tracer as trace_mod
 
@@ -2185,6 +2254,7 @@ def main() -> int:
                     "voc_real_codebook": voc,
                     "chunk_pipeline": chunk_pipeline,
                     "gather_parallel": gather_parallel,
+                    "serve_cold_start": serve_cold_start,
                     "weak_scaling_virtual_mesh": weak_scaling,
                     "trace": trace_extra,
                 },

@@ -1,9 +1,22 @@
 #!/usr/bin/env bash
 # Smoke-test the serving engine on CPU: fit a small pipeline, push
 # synthetic traffic through ServingEngine, assert every response matched
-# and every bucket compiled exactly once (the demo exits nonzero on any
-# mismatch). Extra flags pass through to the demo, e.g.:
+# and every bucket's executable arrived exactly once (the demo exits
+# nonzero on any mismatch). Then boot AGAIN against the same AOT
+# executable cache dir and assert the warm boot paid ZERO pipeline
+# traces — every bucket must load the executable the first boot
+# exported (--expect-zero-compiles makes any warm-boot trace fatal).
+# Extra flags pass through to the demo, e.g.:
 #   bin/serve-smoke.sh --requests 128 --buckets 8,32,64
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m keystone_tpu --serve-demo --backend cpu "$@"
+cachedir="$(mktemp -d /tmp/keystone-aot-smoke-XXXXXX)"
+trap 'rm -rf "$cachedir"' EXIT
+# both cache layers root in the throwaway dir so boot 1 is genuinely cold
+run=(env JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$cachedir/xla"
+     python -m keystone_tpu --serve-demo --backend cpu
+     --aot-cache "$cachedir")
+echo "== boot 1 (cold: traces + exports every bucket) =="
+"${run[@]}" "$@"
+echo "== boot 2 (warm: must load every bucket, zero traces) =="
+"${run[@]}" --expect-zero-compiles "$@"

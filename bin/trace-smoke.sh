@@ -11,6 +11,9 @@
 # A third stage runs a host-bound gather pipeline under the concurrent
 # executor and asserts the scheduled node spans carry queue_wait_seconds /
 # worker attribution and still nest under the pull root.
+# A fourth stage compiles a fitted pipeline against a fresh AOT executable
+# cache twice (fresh process each) and asserts the cache-miss run traces
+# `aot.miss` + `aot.export` spans and the hit run traces `aot.load`.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
@@ -120,3 +123,48 @@ for e in sched:
     assert e["tid"] != pull[0]["tid"], e
 print(f"PAR SPANS OK: {len(sched)} scheduled node span(s) -> {path}")
 PY
+
+# -- AOT executable-cache spans ----------------------------------------------
+aot_dir="$(mktemp -d /tmp/keystone-aot-trace-XXXXXX)"
+trap 'rm -rf "$aot_dir"' EXIT
+for mode in miss hit; do
+  aot_out="$(mktemp /tmp/keystone-aot-trace-XXXXXX.json)"
+  env JAX_PLATFORMS=cpu KEYSTONE_TRACE="$aot_out" \
+    KEYSTONE_AOT_CACHE="$aot_dir" KEYSTONE_COMPILE_CACHE="$aot_dir/xla" \
+    python - "$aot_out" "$mode" <<'PY'
+import json
+import sys
+
+import numpy as np
+
+from keystone_tpu.utils.obs import configure, export_trace
+
+configure()
+
+from keystone_tpu.serving.demo import build_demo_fitted
+
+fitted, _test = build_demo_fitted(n_train=512, n_test=16)
+compiled = fitted.compile()
+x = np.zeros((8, 784), np.float32)
+np.asarray(compiled(x))
+path = export_trace()
+assert path == sys.argv[1], (path, sys.argv[1])
+with open(path) as f:
+    doc = json.load(f)
+names = [e["name"] for e in doc["traceEvents"]]
+mode = sys.argv[2]
+if mode == "miss":
+    assert "aot.miss" in names and "aot.export" in names, names
+    assert "aot.load" not in names, names
+    assert fitted.compile_count == 1, fitted.compiled_signatures
+else:
+    assert "aot.load" in names, names
+    assert "aot.export" not in names, names
+    assert fitted.compile_count == 0, fitted.compiled_signatures
+args = [e for e in doc["traceEvents"] if e["name"].startswith("aot.")][0]["args"]
+# the exporter stringifies non-scalar attrs
+assert args.get("key") and str(args.get("shape")) == "[8, 784]", args
+print(f"AOT SPANS OK ({mode}): "
+      + ", ".join(sorted(n for n in set(names) if n.startswith("aot."))))
+PY
+done

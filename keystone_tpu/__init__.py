@@ -11,6 +11,11 @@ XLA's lowering is unstable (``ops/`` — e.g. the KRR Gaussian kernel block).
 
 import os as _os
 
+#: the XLA cache dir THIS package defaulted jax to (None when the operator
+#: chose one via env/config) — `compile.configure(--aot-cache)` may relocate
+#: a defaulted cache under the AOT dir, but never an operator's choice
+_default_xla_cache_dir = None
+
 
 def _enable_persistent_compile_cache() -> None:
     """Point XLA at an on-disk compilation cache (set
@@ -19,7 +24,8 @@ def _enable_persistent_compile_cache() -> None:
     across processes is free speed for every pipeline."""
     if _os.environ.get("KEYSTONE_NO_COMPILE_CACHE"):
         return
-    cache_dir = _os.environ.get("KEYSTONE_COMPILE_CACHE") or _os.path.join(
+    chosen = _os.environ.get("KEYSTONE_COMPILE_CACHE")
+    cache_dir = chosen or _os.path.join(
         _os.path.expanduser("~"), ".cache", "keystone_tpu", "xla"
     )
     # NOTE: importing this package therefore imports jax and touches global
@@ -37,6 +43,9 @@ def _enable_persistent_compile_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        if not chosen:
+            global _default_xla_cache_dir
+            _default_xla_cache_dir = cache_dir
     except Exception:  # pragma: no cover - jax without these specific knobs
         pass
 

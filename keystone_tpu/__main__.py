@@ -187,12 +187,22 @@ def main(argv: Optional[List[str]] = None) -> int:
              "JSON to PATH — open in chrome://tracing or "
              "https://ui.perfetto.dev (also: KEYSTONE_TRACE=PATH)",
     )
+    p.add_argument(
+        "--aot-cache", default=None, metavar="DIR", dest="aot_cache",
+        help="persistent AOT executable cache directory: fitted-pipeline "
+             "compiles load previously exported executables instead of "
+             "re-tracing, so warm boots skip every compile "
+             "(also: KEYSTONE_AOT_CACHE=DIR)",
+    )
     args, rest = p.parse_known_args(argv)
     if not serve_demo:
         name = _resolve_pipeline(p, args.pipeline)
     from .utils.obs import configure, export_trace
 
-    configure(args.log_level, profile=args.profile or None, trace=args.trace)
+    configure(
+        args.log_level, profile=args.profile or None, trace=args.trace,
+        aot_cache=args.aot_cache,
+    )
     _select_backend(args.backend, args.cpuDevices)
     try:
         if serve_demo:

@@ -1,0 +1,170 @@
+"""AOT pipeline compilation with a persistent executable cache.
+
+The Julia→TPU paper (PAPERS.md #4) compiles whole programs to one
+offline XLA artifact; a fitted KeystoneML pipeline is exactly that shape.
+This package makes a :class:`~keystone_tpu.workflow.pipeline.FittedPipeline`
+boot like one: the first process to compile a (pipeline, input-signature)
+pair exports the traced program via ``jax.export`` into an on-disk cache,
+and every later process — a restarted service, a new serving replica —
+loads the executable instead of re-paying the trace. Warm boots are
+milliseconds of deserialization instead of tens of seconds of tracing
+and XLA compilation.
+
+Layout of a cache directory::
+
+    <dir>/entries/<pipeline-digest>-<signature-digest>.aot   # exported StableHLO
+    <dir>/xla/                                               # layered jax compilation cache
+
+Knobs: ``KEYSTONE_AOT_CACHE=<dir>`` (or ``--aot-cache`` on the CLI, or
+``utils.obs.configure(aot_cache=...)``), ``KEYSTONE_AOT_CACHE_BYTES``
+for the LRU size bound. See the README's "AOT executable cache" section
+for the invalidation rules.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from .aot import AotDispatcher, signature_of
+from .cache import CacheEntry, ExecutableCache
+from .fingerprint import (
+    FingerprintError,
+    entry_key,
+    environment_key,
+    pipeline_fingerprint,
+)
+
+__all__ = [
+    "AotDispatcher",
+    "CacheEntry",
+    "ExecutableCache",
+    "FingerprintError",
+    "configure",
+    "entry_key",
+    "environment_key",
+    "get_cache",
+    "pipeline_fingerprint",
+    "reset",
+    "signature_of",
+]
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_cache: Optional[ExecutableCache] = None
+_initialized = False  # False => next get_cache() reads KEYSTONE_AOT_CACHE
+#: jax config values overwritten by _layer_jax_compilation_cache, so
+#: reset() can put them back: {config_name: prior_value}
+_prior_jax_config: Optional[dict] = None
+#: the XLA dir the layering itself installed — a later configure(other_dir)
+#: may relocate it again (it is ours, not operator-chosen)
+_layered_xla_dir: Optional[str] = None
+
+
+def configure(
+    path: Optional[str] = None, max_bytes: Optional[int] = None
+) -> Optional[ExecutableCache]:
+    """Install the process-wide executable cache.
+
+    ``path=None`` follows ``KEYSTONE_AOT_CACHE`` (unset or empty ⇒ AOT
+    caching disabled). Installing a cache also layers jax's persistent
+    compilation cache underneath at ``<dir>/xla`` — so even a code path
+    that re-lowers (an export round trip, a fallback live compile) hits
+    a warm XLA cache on the second boot — unless the process already
+    configured ``jax_compilation_cache_dir`` itself, which is respected.
+    """
+    global _cache, _initialized
+    with _lock:
+        _initialized = True
+        if path is None:
+            path = os.environ.get("KEYSTONE_AOT_CACHE") or None
+        if not path:
+            _cache = None
+            return None
+        try:
+            _cache = ExecutableCache(path, max_bytes=max_bytes)
+        except Exception:
+            # an unwritable/invalid dir must degrade to AOT-off, not crash
+            # a service that booted fine without the cache
+            logger.warning(
+                "aot: cache dir %r unusable — AOT caching disabled", path,
+                exc_info=True,
+            )
+            _cache = None
+            return None
+        _layer_jax_compilation_cache(_cache)
+        return _cache
+
+
+def get_cache() -> Optional[ExecutableCache]:
+    """The installed cache, or None (AOT caching off). Lazily honors
+    ``KEYSTONE_AOT_CACHE`` so library callers that never touch
+    ``configure`` still get caching when the environment asks for it."""
+    if not _initialized:
+        return configure()
+    return _cache
+
+
+def reset() -> None:
+    """Forget the installed cache AND the env memo, and restore any jax
+    config knobs :func:`configure` overwrote (test hygiene)."""
+    global _cache, _initialized, _prior_jax_config, _layered_xla_dir
+    with _lock:
+        _cache = None
+        _initialized = False
+        _layered_xla_dir = None
+        prior, _prior_jax_config = _prior_jax_config, None
+    if prior:
+        import jax
+
+        for name, value in prior.items():
+            try:
+                jax.config.update(name, value)
+            except Exception:  # pragma: no cover - knob absent in this jax
+                pass
+
+
+def _layer_jax_compilation_cache(cache: ExecutableCache) -> None:
+    """Point jax's own persistent compilation cache under the AOT cache
+    dir, so the XLA compile of a deserialized (or re-lowered) module is a
+    disk lookup on warm boots — and the whole warm-boot state lives in
+    ONE directory an operator can mount into a fresh replica. The package
+    import-time DEFAULT (``~/.cache/keystone_tpu/xla``) is relocated here;
+    a dir the operator chose (``JAX_COMPILATION_CACHE_DIR`` /
+    ``KEYSTONE_COMPILE_CACHE``, or their own ``jax.config``) is kept.
+    The persistence thresholds are zeroed either way: serve programs
+    compile in well under the default minimum compile time, which would
+    skip exactly the entries a warm boot needs."""
+    global _prior_jax_config, _layered_xla_dir
+    try:
+        import jax
+
+        import keystone_tpu as _pkg
+
+        prior = _prior_jax_config if _prior_jax_config is not None else {}
+
+        def _set(name, value):
+            prior.setdefault(name, getattr(jax.config, name))
+            jax.config.update(name, value)
+
+        current_dir = jax.config.jax_compilation_cache_dir
+        relocatable = (
+            not current_dir
+            or current_dir == getattr(_pkg, "_default_xla_cache_dir", None)
+            or current_dir == _layered_xla_dir  # a previous configure()'s
+        )
+        if relocatable:
+            os.makedirs(cache.xla_cache_dir, exist_ok=True)
+            _set("jax_compilation_cache_dir", cache.xla_cache_dir)
+            _layered_xla_dir = cache.xla_cache_dir
+        _set("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _set("jax_persistent_cache_min_entry_size_bytes", -1)
+        _prior_jax_config = prior
+    except Exception:
+        logger.warning(
+            "aot: could not layer the jax persistent compilation cache",
+            exc_info=True,
+        )

@@ -12,6 +12,47 @@ from typing import List, Optional
 import numpy as np
 
 
+def build_demo_fitted(
+    num_ffts: int = 2,
+    block_size: int = 512,
+    lam: float = 100.0,
+    n_train: int = 2048,
+    n_test: int = 64,
+):
+    """The smoke serving pipeline: deterministic synthetic MNIST + random-FFT
+    featurizer + block least squares + argmax. Deterministic end to end, so
+    two processes building it get the SAME fitted parameters — and the same
+    AOT fingerprint, which is what lets the cold-start bench's second
+    process boot from the first one's exported executables. Returns
+    ``(fitted, test_data)``."""
+    import numpy as np
+
+    from ..nodes.util import ClassLabelIndicators, MaxClassifier
+    from ..nodes.learning.linear import BlockLeastSquaresEstimator
+    from ..pipelines.mnist_random_fft import (
+        NUM_CLASSES,
+        MnistRandomFFTConfig,
+        build_featurizer,
+        synthetic_mnist_device,
+    )
+
+    conf = MnistRandomFFTConfig(
+        num_ffts=num_ffts, block_size=block_size, lam=lam
+    )
+    train, test = synthetic_mnist_device(n_train=n_train, n_test=max(n_test, 64))
+    labels = ClassLabelIndicators(NUM_CLASSES).apply_batch(train.labels)
+    fitted = (
+        build_featurizer(conf)
+        .and_then(
+            BlockLeastSquaresEstimator(conf.block_size, 1, conf.lam or 0.0),
+            train.data, labels,
+        )
+        .and_then(MaxClassifier())
+        .fit()
+    )
+    return fitted, np.asarray(test.data.to_array())
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser("keystone-tpu serve-demo")
     p.add_argument("--numFFTs", type=int, default=2)
@@ -25,35 +66,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--maxWaitMs", type=float, default=2.0)
     p.add_argument("--clients", type=int, default=8,
                    help="concurrent submitter threads")
+    p.add_argument(
+        "--expect-zero-compiles", action="store_true",
+        dest="expect_zero_compiles",
+        help="fail unless warm-up paid ZERO pipeline traces — the warm-"
+             "boot assertion for a populated AOT cache (--aot-cache / "
+             "KEYSTONE_AOT_CACHE): every bucket must load its executable",
+    )
     args = p.parse_args(argv)
     buckets = tuple(int(b) for b in args.buckets.split(","))
 
-    from ..nodes.util import ClassLabelIndicators, MaxClassifier
-    from ..nodes.learning.linear import BlockLeastSquaresEstimator
-    from ..pipelines.mnist_random_fft import (
-        NUM_CLASSES,
-        MnistRandomFFTConfig,
-        build_featurizer,
-        synthetic_mnist_device,
-    )
     from .engine import ServingEngine
 
-    conf = MnistRandomFFTConfig(
-        num_ffts=args.numFFTs, block_size=args.blockSize, lam=args.lam
+    fitted, test_data = build_demo_fitted(
+        num_ffts=args.numFFTs, block_size=args.blockSize, lam=args.lam,
+        n_train=args.nTrain, n_test=args.requests,
     )
-    train, test = synthetic_mnist_device(
-        n_train=args.nTrain, n_test=max(args.requests, 64)
-    )
-    labels = ClassLabelIndicators(NUM_CLASSES).apply_batch(train.labels)
-    fitted = (
-        build_featurizer(conf)
-        .and_then(BlockLeastSquaresEstimator(conf.block_size, 1, conf.lam or 0.0),
-                  train.data, labels)
-        .and_then(MaxClassifier())
-        .fit()
-    )
-
-    data = np.asarray(test.data.to_array())[: args.requests]
+    data = test_data[: args.requests]
     engine = ServingEngine(
         fitted,
         buckets=buckets,
@@ -71,8 +100,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     c = snap["counters"]
     lat = snap["latency"]
     occ = snap["batch_occupancy"]["ratio"]
+    compiles = c.get("compiles", 0)
+    aot_loads = c.get("aot_loads", 0)
     print(
-        f"SERVE ok={agree}/{len(data)} compiles={c.get('compiles', 0)} "
+        f"SERVE ok={agree}/{len(data)} compiles={compiles} "
+        f"aot_loads={aot_loads} "
         f"batches={c.get('batches', 0)} completed={c.get('completed', 0)} "
         f"occupancy={'n/a' if occ is None else format(occ, '.3f')} "
         f"p50={lat.get('p50', 0):.4f}s p99={lat.get('p99', 0):.4f}s"
@@ -80,9 +112,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ok = (
         agree == len(data)
         and c.get("completed", 0) == len(data)
-        # policy dedups bucket sizes, so compare against what it kept
-        and c.get("compiles", 0) == len(engine.policy.batch_sizes)
+        # every bucket's executable arrived exactly once — traced live or
+        # loaded from the AOT cache (policy dedups bucket sizes, so
+        # compare against what it kept)
+        and compiles + aot_loads == len(engine.policy.batch_sizes)
     )
+    if args.expect_zero_compiles and compiles != 0:
+        print(f"SERVE FAIL: warm boot paid {compiles} trace(s), expected 0")
+        ok = False
     print("SERVE " + ("PASS" if ok else "FAIL"))
     return 0 if ok else 1
 

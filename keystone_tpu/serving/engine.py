@@ -57,8 +57,11 @@ class ServingEngine:
         Static batch-size buckets (largest = max micro-batch size).
     datum_shape / dtype:
         Per-item array contract. With ``datum_shape`` given, ``start()``
-        pre-compiles every bucket before traffic; without it, the shape
-        locks to the first request (first batch then pays its compile).
+        pre-compiles every bucket before traffic. When omitted, the
+        contract recorded on the fitted pipeline at fit time
+        (``FittedPipeline.datum_shape``/``datum_dtype``) is used; only
+        when neither exists does the shape lock to the first request
+        (whose batch then pays its compile).
     max_queue:
         Admission-queue bound; submissions beyond it raise
         :class:`QueueFull`.
@@ -72,7 +75,7 @@ class ServingEngine:
         *,
         buckets: Sequence[int] = (1, 8, 32, 64),
         datum_shape: Optional[Sequence[int]] = None,
-        dtype: Any = "float32",
+        dtype: Any = None,
         max_queue: int = 256,
         max_wait_ms: float = 2.0,
         metrics: Optional[MetricsRegistry] = None,
@@ -93,6 +96,16 @@ class ServingEngine:
             # Queue(maxsize=0) would mean UNBOUNDED in python — the exact
             # opposite of the backpressure contract
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        # the per-item serving contract: explicit args win; otherwise fall
+        # back to what the pipeline recorded at fit time, so a warm-up-able
+        # engine needs no out-of-band shape plumbing. Shape and dtype fall
+        # back independently — an explicit shape must not discard the
+        # recorded dtype (warming float32 buckets for float64 traffic
+        # would re-trace every bucket under load).
+        if datum_shape is None:
+            datum_shape = getattr(fitted, "datum_shape", None)
+        if dtype is None:
+            dtype = getattr(fitted, "datum_dtype", None) or "float32"
         self._policy = BucketPolicy(buckets, datum_shape, dtype)
         self._metrics = metrics or MetricsRegistry()
         # Strict compile: fail at construction, naming the blocking node,
@@ -103,6 +116,11 @@ class ServingEngine:
         # engine discard the first's warm cache). Every XLA trace — one per
         # distinct padded shape — records its signature and bumps the
         # "compiles" counter, the invariant the bucket policy protects.
+        # With an AOT executable cache configured (KEYSTONE_AOT_CACHE /
+        # --aot-cache), each bucket shape first tries to LOAD a previously
+        # exported executable — a warm boot pays ZERO traces ("aot_loads"
+        # counts them) — and a miss traces once, then exports for the next
+        # process.
         import jax
 
         fn = fitted.trace_fn()
@@ -112,12 +130,19 @@ class ServingEngine:
         self._compiled_signatures = signatures
         metrics_ref = self._metrics
 
-        def _traced(x):
-            signatures.append((tuple(x.shape), str(x.dtype)))
+        def _note_trace(sig):
+            signatures.append(sig)
             metrics_ref.inc("compiles")
-            return fn(x)
 
-        self._compiled = jax.jit(_traced)
+        self._aot = self._build_aot_dispatcher(fitted, fn, _note_trace)
+        if self._aot is not None:
+            self._compiled = self._aot
+        else:
+            def _traced(x):
+                _note_trace((tuple(x.shape), str(x.dtype)))
+                return fn(x)
+
+            self._compiled = jax.jit(_traced)
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._max_wait = max_wait_ms / 1000.0
         self._log_interval = log_interval_s
@@ -136,6 +161,42 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._metrics.set_gauge("queue_depth", self._queue.qsize)
 
+    def _build_aot_dispatcher(self, fitted, fn, note_trace):
+        """The engine's PRIVATE cache-aware compile path (same isolation
+        contract as the private jit). None when no cache is configured or
+        the pipeline cannot be content-keyed — then the legacy jit serves."""
+        from .. import compile as compile_mod
+
+        cache = compile_mod.get_cache()
+        if cache is None:
+            return None
+        try:
+            digest = fitted.fingerprint()
+        except compile_mod.FingerprintError as e:
+            logger.info(
+                "serving: AOT cache skipped (pipeline not fingerprintable): %s", e
+            )
+            return None
+        except Exception:
+            # e.g. RecursionError on self-referential operator state: a
+            # pipeline that serves fine without the cache must not crash
+            # at construction because caching was enabled
+            logger.warning(
+                "serving: AOT cache skipped (fingerprinting failed)",
+                exc_info=True,
+            )
+            return None
+        metrics_ref = self._metrics
+
+        def _note_load(sig):
+            # NOT a compiled signature: no trace was paid for this bucket
+            metrics_ref.inc("aot_loads")
+
+        return compile_mod.AotDispatcher(
+            fn, digest, cache,
+            on_trace=note_trace, on_load=_note_load, label="serving",
+        )
+
     @property
     def metrics(self) -> MetricsRegistry:
         return self._metrics
@@ -152,13 +213,26 @@ class ServingEngine:
 
     # -- lifecycle ------------------------------------------------------
 
-    def warm_up(self) -> int:
+    def warm_up(self, required: bool = True) -> int:
         """Run one zero batch per bucket through the compiled fn, paying
-        every bucket's compile before traffic. Returns buckets warmed (0
-        when the datum shape is not configured yet)."""
+        (or — with an AOT cache — loading) every bucket's executable
+        before traffic. Returns buckets warmed.
+
+        ``required=True`` (the default, and any direct call) RAISES when
+        warm-up is impossible because no datum shape is known — a service
+        that asked to pre-pay its compiles must not silently boot cold and
+        pay them under traffic. ``required=False`` (``start()``'s
+        best-effort default) downgrades that to the old warning + 0."""
         import jax
 
         if self._policy.datum_shape is None:
+            if required:
+                raise ValueError(
+                    "warm-up requested but impossible: no datum shape is "
+                    "known — pass datum_shape= to the engine, or fit the "
+                    "pipeline through and_then(estimator, data) so the "
+                    "contract is recorded on the FittedPipeline"
+                )
             logger.warning(
                 "serving warm-up skipped: no datum_shape configured — the "
                 "first live batch of each bucket will pay its compile"
@@ -169,19 +243,25 @@ class ServingEngine:
             jax.block_until_ready(self._compiled(x))
             n += 1
         logger.info(
-            "serving warm-up: %d bucket(s) %s compiled (%d traces total)",
-            n, self._policy.batch_sizes, self._metrics.count("compiles"),
+            "serving warm-up: %d bucket(s) %s ready (%d traced, %d loaded "
+            "from the AOT cache)",
+            n, self._policy.batch_sizes,
+            self._metrics.count("compiles"), self._metrics.count("aot_loads"),
         )
         return n
 
-    def start(self, warmup: bool = True) -> "ServingEngine":
+    def start(self, warmup: Optional[bool] = None) -> "ServingEngine":
+        """Start the worker. ``warmup=None`` (default) warms up when the
+        datum shape is known and skips with a warning otherwise;
+        ``warmup=True`` demands it (raises if impossible); ``warmup=False``
+        boots cold."""
         with self._lifecycle_lock:
             if self._thread is not None:
                 raise RuntimeError("engine already started")
             if self._closed:
                 raise EngineClosed("engine was shut down")
-            if warmup:
-                self.warm_up()
+            if warmup or warmup is None:
+                self.warm_up(required=warmup is True)
             self._thread = threading.Thread(
                 target=self._worker_loop, name="keystone-serving-worker",
                 daemon=True,

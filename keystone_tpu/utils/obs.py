@@ -21,6 +21,10 @@ Environment switches (read by the CLI and by ``configure(None)``):
 * ``KEYSTONE_TRACE=/path/trace.json`` — install the pipeline tracer
   (``keystone_tpu.obs``) and export a Chrome-trace/Perfetto JSON at
   process exit (or explicitly via :func:`export_trace`).
+* ``KEYSTONE_AOT_CACHE=/path/dir`` — install the persistent AOT
+  executable cache (``keystone_tpu.compile``): fitted-pipeline compiles
+  load previously exported executables instead of re-tracing, and jax's
+  persistent compilation cache is layered underneath.
 """
 
 from __future__ import annotations
@@ -67,6 +71,7 @@ def configure(
     level: Optional[str] = None,
     profile: Optional[bool] = None,
     trace: Optional[str] = None,
+    aot_cache: Optional[str] = None,
 ) -> None:
     """Configure logging (and optionally phase profiling) process-wide.
 
@@ -76,9 +81,12 @@ def configure(
     enable/disable phase syncs+logs, ``None`` follows ``KEYSTONE_PROFILE``
     (off unless set to something truthy). ``trace`` is a Chrome-trace
     output path enabling the pipeline tracer (``keystone_tpu.obs``);
-    ``None`` follows ``KEYSTONE_TRACE`` (off unless set). Idempotent;
-    later calls re-level the root handler and re-apply the profiling
-    switch, and an already-installed tracer is kept (spans survive).
+    ``None`` follows ``KEYSTONE_TRACE`` (off unless set). ``aot_cache``
+    is a directory path enabling the persistent AOT executable cache
+    (``keystone_tpu.compile``); ``None`` follows ``KEYSTONE_AOT_CACHE``
+    (off unless set). Idempotent; later calls re-level the root handler
+    and re-apply the profiling switch, and an already-installed tracer
+    is kept (spans survive).
     """
     global _configured
     from_env = level is None
@@ -119,6 +127,18 @@ def configure(
         from ..obs import tracer as _obs_tracer
 
         _obs_tracer.start(path=trace)
+
+    # an explicit aot_cache path (or "" to disable) reconfigures the AOT
+    # executable cache; aot_cache=None only ensures the KEYSTONE_AOT_CACHE
+    # env default is honored — like the tracer, an already-installed cache
+    # is KEPT, so a later configure("debug") call to re-level logging
+    # cannot silently uninstall it
+    from .. import compile as _compile_mod
+
+    if aot_cache is not None:
+        _compile_mod.configure(aot_cache)
+    else:
+        _compile_mod.get_cache()
 
 
 def export_trace(path: Optional[str] = None) -> Optional[str]:

@@ -55,6 +55,10 @@ class FusedTransformerOperator(TransformerOperator):
     one slot per step. The last step is the output.
     """
 
+    #: ``_jit`` is derived memo state — a warm operator must AOT-fingerprint
+    #: identically to a fresh one (see ``compile/fingerprint.py``)
+    aot_fingerprint_exclude = ("_jit",)
+
     def __init__(self, steps: Sequence[Tuple[TransformerOperator, Tuple[int, ...]]],
                  n_inputs: int):
         self.steps = list(steps)

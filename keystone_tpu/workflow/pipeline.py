@@ -122,6 +122,29 @@ class PipelineDatum(PipelineResult):
 # ---------------------------------------------------------------------------
 
 
+def datum_spec_of(data: Any) -> Optional[tuple]:
+    """Best-effort per-item ``(shape, dtype)`` of a batch-shaped value —
+    the serving contract implied by feeding ``data`` at a pipeline's
+    source. None when it is not CHEAPLY knowable (lazy results, item
+    lists, chunked scans): this is a hint recorded at fit time, never a
+    reason to materialize anything."""
+    try:
+        if isinstance(data, PipelineResult):
+            return None  # lazy; forcing it here would execute the graph
+        payload = data
+        if isinstance(payload, Dataset):
+            if not payload.is_batched:
+                return None
+            payload = payload.payload
+        shape = getattr(payload, "shape", None)
+        dtype = getattr(payload, "dtype", None)
+        if shape is None or dtype is None or len(shape) < 1:
+            return None
+        return (tuple(int(d) for d in shape[1:]), str(dtype))
+    except Exception:
+        return None
+
+
 def attach_data(graph: Graph, data: Any) -> tuple:
     """Add ``data`` to ``graph`` as a dependency-able id.
 
@@ -187,7 +210,13 @@ class Chainable:
                 )
             trained_input = self(fit_data[0])
             fitted = nxt.with_data(trained_input, *fit_data[1:])
-            return self.to_pipeline()._compose(fitted)
+            composed = self.to_pipeline()._compose(fitted)
+            # fit_data[0] is fed at the chain's SOURCE (self is the whole
+            # prefix), so its per-item spec is the serving datum contract —
+            # recorded as a hint for warm-up/AOT consumers of the fit
+            if composed._datum_hint is None:
+                composed._datum_hint = datum_spec_of(fit_data[0])
+            return composed
         if isinstance(nxt, Chainable):
             if fit_data:
                 raise ValueError("fit data only applies when chaining an estimator")
@@ -215,6 +244,11 @@ class Pipeline(Chainable):
         self._graph = graph
         self._source = source
         self._sink = sink
+        #: per-item ``(shape, dtype)`` of data this chain's source has been
+        #: fed (recorded by ``and_then(estimator, data)``); carried into
+        #: the FittedPipeline so serving can warm up without being told
+        #: the datum shape again
+        self._datum_hint: Optional[tuple] = None
 
     # -- structure ------------------------------------------------------
 
@@ -241,7 +275,11 @@ class Pipeline(Chainable):
         merged, source_map, sink_map = self._graph.connect_graph(
             nxt._graph, {self._sink: nxt._source}
         )
-        return Pipeline(merged, self._source, sink_map[nxt._sink])
+        composed = Pipeline(merged, self._source, sink_map[nxt._sink])
+        # the composed source IS self's source, so only self's hint applies
+        # (nxt's hint described nxt's own source, now an interior edge)
+        composed._datum_hint = self._datum_hint
+        return composed
 
     # -- application ----------------------------------------------------
 
@@ -325,7 +363,12 @@ class Pipeline(Chainable):
             op = graph.get_operator(node)
             if not isinstance(op, (TransformerOperator, ExpressionOperator, DatasetOperator, DatumOperator)):
                 raise TypeError(f"fit() left a non-transformer operator in the graph: {op.label}")
-        return FittedPipeline(graph, self._source, self._sink)
+        hint = self._datum_hint
+        return FittedPipeline(
+            graph, self._source, self._sink,
+            datum_shape=hint[0] if hint else None,
+            datum_dtype=hint[1] if hint else None,
+        )
 
     # -- combinators ----------------------------------------------------
 
@@ -369,21 +412,43 @@ class FittedPipeline(Chainable):
     and compilable to a single jitted function
     (parity: ``FittedPipeline.scala`` + the XLA-fusion north star)."""
 
-    def __init__(self, graph: Graph, source: SourceId, sink: SinkId):
+    def __init__(
+        self,
+        graph: Graph,
+        source: SourceId,
+        sink: SinkId,
+        *,
+        datum_shape: Optional[tuple] = None,
+        datum_dtype: Optional[str] = None,
+    ):
         self._graph = graph
         self._source = source
         self._sink = sink
+        #: per-item input contract recorded at fit time (from the data the
+        #: chain's estimators were fed) — lets a serving engine warm up
+        #: without being handed the shape again; None when not knowable
+        self.datum_shape: Optional[tuple] = (
+            tuple(int(d) for d in datum_shape) if datum_shape is not None else None
+        )
+        self.datum_dtype: Optional[str] = (
+            str(datum_dtype) if datum_dtype is not None else None
+        )
         self._compiled: Optional[Callable] = None
         #: one entry per XLA trace of the compiled function — ``(shape, dtype)``
         #: of the stacked input. len() == number of compiles paid so far.
         self._compiled_signatures: List[tuple] = []
+        #: memoized content fingerprint (the graph is immutable post-fit)
+        self._fingerprint: Optional[str] = None
 
     @property
     def graph(self) -> Graph:
         return self._graph
 
     def to_pipeline(self) -> Pipeline:
-        return Pipeline(self._graph, self._source, self._sink)
+        p = Pipeline(self._graph, self._source, self._sink)
+        if self.datum_shape is not None and self.datum_dtype is not None:
+            p._datum_hint = (self.datum_shape, self.datum_dtype)
+        return p
 
     # -- application (no optimizer pass and NO re-fusion: parity with the
     #    reference, which applies FittedPipelines without re-optimizing — and
@@ -479,12 +544,26 @@ class FittedPipeline(Chainable):
 
         return fn
 
+    def fingerprint(self) -> str:
+        """Canonical content digest of this pipeline — graph topology +
+        operator identities + fitted-parameter digests; stable across
+        processes (see ``compile/fingerprint.py``). Raises
+        :class:`~keystone_tpu.compile.FingerprintError` when some operator
+        state has no content-stable form. Memoized: the graph is immutable
+        after fit."""
+        if self._fingerprint is None:
+            from ..compile import pipeline_fingerprint
+
+            self._fingerprint = pipeline_fingerprint(self)
+        return self._fingerprint
+
     def compile(
         self,
         strict: bool = True,
         on_trace: Optional[Callable[[tuple], None]] = None,
+        cache: Any = "auto",
     ) -> Optional[Callable]:
-        """Jit the composed transformer chain into one XLA computation.
+        """Compile the composed transformer chain into one XLA computation.
 
         ``strict=True`` (default) raises :class:`NotTraceableError` naming the
         blocking nodes, so a service can fail fast at construction instead of
@@ -498,6 +577,16 @@ class FittedPipeline(Chainable):
         assert shape-stability invariants. (The serving engine keeps its own
         private jit with equivalent per-trace accounting so that direct use
         of this method cannot pollute a live engine's counters.)
+
+        ``cache`` selects the AOT executable cache
+        (:mod:`keystone_tpu.compile`): ``"auto"`` (default) uses the
+        process-configured cache (``KEYSTONE_AOT_CACHE`` / ``--aot-cache``)
+        when the pipeline fingerprints; an :class:`ExecutableCache` uses
+        that cache; ``None`` forces the legacy in-process jit. With a cache,
+        each input signature first tries to LOAD a previously exported
+        executable — a hit pays zero traces (``compiled_signatures`` stays
+        empty for it) — and a miss traces once, exports, and persists for
+        every future process.
         """
         import jax
 
@@ -512,18 +601,50 @@ class FittedPipeline(Chainable):
         self._compiled_signatures = []
         signatures = self._compiled_signatures
 
+        def note_trace(sig):
+            signatures.append(sig)
+            if on_trace is not None:
+                on_trace(sig)
+
+        aot = self._aot_dispatcher(fn, cache, note_trace)
+        if aot is not None:
+            self._compiled = aot
+            return self._compiled
+
         def traced(x):
             # runs only while jax traces, i.e. exactly once per compile;
             # bound to THIS jit's list so a superseded executable that
             # retraces can't pollute the replacement's accounting
-            sig = (tuple(x.shape), str(x.dtype))
-            signatures.append(sig)
-            if on_trace is not None:
-                on_trace(sig)
+            note_trace((tuple(x.shape), str(x.dtype)))
             return fn(x)
 
         self._compiled = jax.jit(traced)
         return self._compiled
+
+    def _aot_dispatcher(
+        self, fn: Callable, cache: Any, note_trace: Callable
+    ) -> Optional[Callable]:
+        """Build the cache-aware per-signature dispatcher, or None when AOT
+        caching is off / the pipeline cannot be content-keyed."""
+        from .. import compile as compile_mod
+
+        if cache == "auto":
+            cache = compile_mod.get_cache()
+        if cache is None:
+            return None
+        try:
+            digest = self.fingerprint()
+        except compile_mod.FingerprintError as e:
+            logger.info("aot cache skipped (pipeline not fingerprintable): %s", e)
+            return None
+        except Exception:
+            # a fingerprint walk blowing up (self-referential state, exotic
+            # objects) must cost the cache, never the compile
+            logger.warning("aot cache skipped (fingerprinting failed)", exc_info=True)
+            return None
+        return compile_mod.AotDispatcher(
+            fn, digest, cache, on_trace=note_trace, label="pipeline.compile"
+        )
 
     @property
     def compiled_signatures(self) -> List[tuple]:
@@ -650,5 +771,9 @@ class FittedPipeline(Chainable):
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        # pickles from before compile-signature tracking
+        # pickles from before compile-signature tracking / datum hints /
+        # AOT fingerprinting
         self.__dict__.setdefault("_compiled_signatures", [])
+        self.__dict__.setdefault("datum_shape", None)
+        self.__dict__.setdefault("datum_dtype", None)
+        self.__dict__.setdefault("_fingerprint", None)

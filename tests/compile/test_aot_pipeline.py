@@ -1,0 +1,165 @@
+"""AOT compile wiring: FittedPipeline.compile and the ServingEngine load
+executables instead of tracing, fall back to live compiles on any cache
+problem with bit-identical outputs, and invalidate on environment skew."""
+
+import numpy as np
+import pytest
+
+import keystone_tpu.compile as cmod
+from keystone_tpu import FunctionNode
+from keystone_tpu.compile import AotDispatcher, ExecutableCache
+from keystone_tpu.serving import ServingEngine
+from keystone_tpu.utils import serialization
+
+from .test_fingerprint import build_toy
+
+DATUM = (8,)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_cache():
+    """These tests install a process-global cache; the rest of the suite
+    must not inherit it (nor a dangling tmp dir)."""
+    yield
+    cmod.reset()
+
+
+def _x(n=4):
+    return np.linspace(0.0, 1.0, n * DATUM[0], dtype=np.float32).reshape(n, *DATUM)
+
+
+# ---------------------------------------------------------------------------
+# FittedPipeline.compile
+# ---------------------------------------------------------------------------
+
+
+def test_compile_exports_then_loads_with_zero_traces(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    fitted = build_toy()
+    cold = fitted.compile(cache=cache)
+    y_cold = np.asarray(cold(_x()))
+    assert fitted.compile_count == 1  # the export's trace, counted
+    assert len(cache.entries()) == 1
+
+    clone = serialization.loads(serialization.dumps(fitted))
+    warm = clone.compile(cache=cache)
+    y_warm = np.asarray(warm(_x()))
+    assert clone.compile_count == 0, "warm boot must pay zero traces"
+
+    legacy = np.asarray(build_toy().compile(cache=None)(_x()))
+    assert np.array_equal(y_cold, y_warm)
+    assert np.array_equal(y_cold, legacy)
+
+
+def test_corrupted_entry_falls_back_to_live_compile(tmp_path):
+    import os
+
+    cache = ExecutableCache(str(tmp_path))
+    fitted = build_toy()
+    y_ref = np.asarray(fitted.compile(cache=cache)(_x()))
+    (key, size, _mtime), = cache.entries()
+    with open(cache.entry_path(key), "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"ROT!")
+
+    clone = serialization.loads(serialization.dumps(fitted))
+    y = np.asarray(clone.compile(cache=cache)(_x()))
+    assert clone.compile_count == 1  # live compile paid, not a crash
+    assert np.array_equal(y, y_ref), "fallback must not change results"
+    assert len(cache.entries()) == 1  # re-exported over the corrupt entry
+
+
+def test_environment_skew_is_a_miss_then_a_fresh_export(tmp_path):
+    """A cache written by a different toolchain (simulated by skewing the
+    dispatcher's environment key) never loads — the pipeline re-traces
+    and re-exports under its own key."""
+    cache = ExecutableCache(str(tmp_path))
+    fitted = build_toy()
+    fitted.compile(cache=cache)(_x())
+    assert len(cache.entries()) == 1
+
+    fn = fitted.trace_fn()
+    traces = []
+    disp = AotDispatcher(
+        fn, fitted.fingerprint(), cache, on_trace=traces.append
+    )
+    disp._env = dict(disp._env, jax="0.0.0-skewed")
+    y = np.asarray(disp(_x()))
+    assert traces, "skewed environment must not load the old entry"
+    assert np.array_equal(y, np.asarray(fitted.compile(cache=None)(_x())))
+    assert len(cache.entries()) == 2  # old entry intact + new env's entry
+
+
+def test_unfingerprintable_pipeline_compiles_without_cache(tmp_path):
+    fitted = (
+        FunctionNode(batch_fn=lambda X: X * 2.0, label="dbl").to_pipeline()
+    ).fit()
+    # a lambda fingerprints by code digest; sabotage with a live object
+    next(iter(fitted.graph.operators.values())).opaque = object()
+    cache = ExecutableCache(str(tmp_path))
+    compiled = fitted.compile(cache=cache)
+    y = np.asarray(compiled(_x()))
+    assert fitted.compile_count == 1
+    assert cache.entries() == []  # silently fell back to the legacy jit
+    assert np.allclose(y, _x() * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# ServingEngine warm boots
+# ---------------------------------------------------------------------------
+
+
+def _serve(engine, rows):
+    with engine:
+        return [engine.predict(r, timeout=60.0) for r in rows]
+
+
+def test_engine_cold_then_warm_boot_zero_traces(tmp_path):
+    cmod.configure(str(tmp_path))
+    fitted = build_toy()
+    rows = _x(6)
+
+    cold = ServingEngine(fitted, buckets=(4, 8), datum_shape=DATUM)
+    preds_cold = _serve(cold, rows)
+    c = cold.metrics.snapshot()["counters"]
+    assert c.get("compiles") == 2 and c.get("aot_loads", 0) == 0
+
+    warm = ServingEngine(fitted, buckets=(4, 8), datum_shape=DATUM)
+    preds_warm = _serve(warm, rows)
+    c = warm.metrics.snapshot()["counters"]
+    assert c.get("compiles", 0) == 0, "warm boot must pay zero traces"
+    assert c.get("aot_loads") == 2
+    assert np.array_equal(np.asarray(preds_cold), np.asarray(preds_warm))
+
+
+def test_configure_relocates_default_xla_cache_but_not_a_chosen_one(tmp_path):
+    """The whole warm-boot state must live in ONE mountable dir: the
+    package-default XLA cache relocates under the AOT dir; an
+    operator-chosen dir is respected. reset() restores either way."""
+    import jax
+
+    import keystone_tpu as pkg
+
+    before = jax.config.jax_compilation_cache_dir
+    cmod.configure(str(tmp_path))
+    try:
+        if before and before != getattr(pkg, "_default_xla_cache_dir", None):
+            # operator-chosen (env/config): must be untouched
+            assert jax.config.jax_compilation_cache_dir == before
+        else:
+            assert jax.config.jax_compilation_cache_dir == str(
+                tmp_path / "xla"
+            )
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    finally:
+        cmod.reset()
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_engine_without_cache_behaves_exactly_as_before(tmp_path):
+    cmod.configure(None)  # explicit: AOT off
+    fitted = build_toy()
+    engine = ServingEngine(fitted, buckets=(4,), datum_shape=DATUM)
+    _serve(engine, _x(3))
+    c = engine.metrics.snapshot()["counters"]
+    assert c.get("compiles") == 1 and c.get("aot_loads", 0) == 0

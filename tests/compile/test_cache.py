@@ -1,0 +1,133 @@
+"""ExecutableCache: the disk format degrades to a miss under every
+failure mode, the LRU size bound holds, and concurrent processes can
+hammer one directory safely."""
+
+import os
+import subprocess
+import sys
+import time
+
+from keystone_tpu.compile.cache import ExecutableCache
+
+ENV = {"jax": "0.0.1", "backend": "cpu"}
+
+
+def _store(cache, key, payload=b"payload-bytes", env=ENV, **extra):
+    return cache.store(key, payload, {"env": dict(env), **extra})
+
+
+def test_round_trip(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    _store(cache, "k1", b"blob", trace_seconds=1.5)
+    entry = cache.load("k1", expect_env=ENV)
+    assert entry is not None
+    assert entry.payload == b"blob"
+    assert entry.header["trace_seconds"] == 1.5
+    assert entry.header["env"] == ENV
+
+
+def test_absent_key_is_a_miss(tmp_path):
+    assert ExecutableCache(str(tmp_path)).load("nope", expect_env=ENV) is None
+
+
+def test_corrupted_payload_is_discarded(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    path = _store(cache, "k1", b"x" * 256)
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        f.write(b"ROT!")
+    assert cache.load("k1", expect_env=ENV) is None
+    assert not os.path.exists(path)  # corrupt entries are removed
+
+
+def test_truncated_entry_is_discarded(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    path = _store(cache, "k1", b"x" * 256)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 10)
+    assert cache.load("k1", expect_env=ENV) is None
+    assert not os.path.exists(path)
+
+
+def test_environment_mismatch_is_a_miss_not_a_crash(tmp_path):
+    """A stale-toolchain entry (e.g. written by another jax version) must
+    never load — and must NOT be deleted: its own toolchain may still
+    want it."""
+    cache = ExecutableCache(str(tmp_path))
+    path = _store(cache, "k1", env={"jax": "0.0.0", "backend": "cpu"})
+    assert cache.load("k1", expect_env=ENV) is None
+    assert os.path.exists(path)
+    assert cache.load("k1", expect_env={"jax": "0.0.0", "backend": "cpu"})
+
+
+def test_renamed_entry_is_rejected(tmp_path):
+    """The header binds the file to its key — a copied/renamed entry
+    cannot masquerade as a different pipeline's executable."""
+    cache = ExecutableCache(str(tmp_path))
+    _store(cache, "k1")
+    os.rename(cache.entry_path("k1"), cache.entry_path("k2"))
+    assert cache.load("k2", expect_env=ENV) is None
+
+
+def test_lru_eviction_respects_recency_and_keeps_newest(tmp_path):
+    payload = b"x" * 1000
+    cache = ExecutableCache(str(tmp_path), max_bytes=2500)
+    _store(cache, "a", payload)
+    time.sleep(0.02)
+    _store(cache, "b", payload)
+    time.sleep(0.02)
+    assert cache.load("a", expect_env=ENV)  # bump a's recency above b's
+    time.sleep(0.02)
+    _store(cache, "c", payload)  # over budget -> evict oldest mtime (b)
+    keys = {k for k, _, _ in cache.entries()}
+    assert "c" in keys, "the just-written entry must never be evicted"
+    assert "a" in keys and "b" not in keys
+    assert cache.total_bytes() <= 2500
+
+
+def test_no_temp_files_left_behind(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    _store(cache, "k1")
+    _store(cache, "k1", b"replacement")  # overwrite is atomic too
+    leftovers = [n for n in os.listdir(cache.entries_dir) if n.startswith(".tmp")]
+    assert leftovers == []
+    assert cache.load("k1", expect_env=ENV).payload == b"replacement"
+
+
+_WORKER = r"""
+import os, sys
+from keystone_tpu.compile.cache import ExecutableCache
+
+root, seed = sys.argv[1], int(sys.argv[2])
+cache = ExecutableCache(root, max_bytes=1 << 20)
+env = {"jax": "0.0.1", "backend": "cpu"}
+payload = (b"%d-" % seed) * 64
+for i in range(40):
+    key = "shared-%d" % (i % 4)
+    cache.store(key, payload, {"env": env, "writer": seed})
+    got = cache.load(key, expect_env=env)
+    # a concurrent writer may have replaced it, but a load is either a
+    # clean miss or a COMPLETE entry from some writer - never torn bytes
+    if got is not None:
+        first = got.payload[:2]
+        assert first in (b"1-", b"2-"), got.payload[:8]
+        assert got.payload == first * 64
+print("OK")
+"""
+
+
+def test_two_process_concurrent_read_write(tmp_path):
+    """Two processes store+load the same keys concurrently: every load
+    sees a complete entry or a miss, and nobody crashes."""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(tmp_path), str(seed)],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for seed in (1, 2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-2000:]
+        assert out.strip() == "OK"

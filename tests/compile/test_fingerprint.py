@@ -1,0 +1,178 @@
+"""Pipeline fingerprinting: the cache key must be stable across processes
+and across harmless runtime state, and must move when anything that
+changes the compiled program moves."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from keystone_tpu import FunctionNode, Transformer
+from keystone_tpu.compile import (
+    FingerprintError,
+    entry_key,
+    pipeline_fingerprint,
+)
+from keystone_tpu.utils.params import as_param
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _double(X):
+    return X * 2.0
+
+
+class _Scale(Transformer):
+    """Deterministic fitted-parameter stand-in (numpy state)."""
+
+    def __init__(self, w):
+        self.w = as_param(w)
+
+    def trace_batch(self, X):
+        return X * self.w
+
+
+def build_toy(scale: float = 3.0):
+    """Deterministic transformer-only chain, buildable identically in any
+    process (module-level functions, content-known parameters)."""
+    w = np.arange(8, dtype=np.float32) * scale + 1.0
+    return (
+        FunctionNode(batch_fn=_double, label="double") >> _Scale(w)
+    ).fit()
+
+
+def toy_digest(scale: float = 3.0) -> str:
+    return pipeline_fingerprint(build_toy(scale))
+
+
+def test_rebuild_gives_identical_digest():
+    assert toy_digest() == toy_digest()
+
+
+def test_digest_moves_with_parameters():
+    assert toy_digest(3.0) != toy_digest(4.0)
+
+
+def test_digest_stable_after_use():
+    """Executing the pipeline populates memo state (the fused operator's
+    ``_jit``); a warm pipeline must fingerprint like a fresh one."""
+    fitted = build_toy()
+    before = pipeline_fingerprint(fitted)
+    fitted.apply(np.ones((4, 8), np.float32))
+    fitted.compile(cache=None)(np.ones((4, 8), np.float32))
+    assert pipeline_fingerprint(fitted) == before
+
+
+def test_digest_survives_pickle_round_trip():
+    from keystone_tpu.utils import serialization
+
+    fitted = build_toy()
+    clone = serialization.loads(serialization.dumps(fitted))
+    assert pipeline_fingerprint(clone) == pipeline_fingerprint(fitted)
+
+
+def test_digest_stable_across_processes():
+    """The property the whole cache stands on: a DIFFERENT process
+    building the same fitted pipeline derives the same key."""
+    out = subprocess.run(
+        [
+            sys.executable, "-c",
+            "from tests.compile.test_fingerprint import toy_digest;"
+            "print(toy_digest())",
+        ],
+        cwd=_REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().splitlines()[-1] == toy_digest()
+
+
+def _inner3(X):
+    return (lambda: 3.0)() * X
+
+
+def _inner4(X):
+    return (lambda: 4.0)() * X
+
+
+def _kw2(X, *, s=2.0):
+    return X * s
+
+
+def _kw3(X, *, s=3.0):
+    return X * s
+
+
+def test_digest_sees_nested_code_and_kwdefaults():
+    """Functions differing only in an inner lambda's body, or only in a
+    keyword-only default, must not collide (a collision would serve one
+    model's executable for the other)."""
+
+    def fp(fn):
+        return pipeline_fingerprint(
+            FunctionNode(batch_fn=fn, label="f").to_pipeline().fit()
+        )
+
+    assert fp(_inner3) != fp(_inner4)
+    assert fp(_kw2) != fp(_kw3)
+
+
+def _with_global(scale: float):
+    """Same code, different module-global value — only the global differs."""
+    ns = {"SCALE": scale}
+    exec("def f(X):\n    return X * SCALE", ns)
+    return ns["f"]
+
+
+def test_digest_sees_referenced_module_globals():
+    """`def f(X): return X * SCALE` must re-key when SCALE changes, or an
+    edited model would load the stale executable."""
+
+    def fp(fn):
+        return pipeline_fingerprint(
+            FunctionNode(batch_fn=fn, label="f").to_pipeline().fit()
+        )
+
+    assert fp(_with_global(2.0)) != fp(_with_global(3.0))
+    assert fp(_with_global(2.0)) == fp(_with_global(2.0))
+
+
+def test_object_dtype_arrays_digest_by_content_not_pointers():
+    """tobytes() on an object array would serialize PyObject pointers —
+    process-unstable; elements must digest by content instead."""
+
+    def fp(meta):
+        fitted = build_toy()
+        next(iter(fitted.graph.operators.values())).meta = np.array(
+            meta, dtype=object
+        )
+        return pipeline_fingerprint(fitted)
+
+    assert fp(["a", 1.5]) == fp(["a", 1.5])
+    assert fp(["a", 1.5]) != fp(["b", 1.5])
+
+
+def test_uncanonicalizable_state_raises():
+    class Opaque(Transformer):
+        def __init__(self):
+            self.handle = object()  # no content-stable form
+
+        def trace_batch(self, X):
+            return X
+
+    fitted = (FunctionNode(batch_fn=_double, label="double") >> Opaque()).fit()
+    with pytest.raises(FingerprintError, match="handle"):
+        pipeline_fingerprint(fitted)
+
+
+def test_entry_key_separates_signature_and_environment():
+    env = {"jax": "1", "backend": "cpu"}
+    base = entry_key("a" * 64, (8, 4), "float32", env)
+    assert entry_key("a" * 64, (16, 4), "float32", env) != base
+    assert entry_key("a" * 64, (8, 4), "float64", env) != base
+    assert entry_key("a" * 64, (8, 4), "float32", {**env, "jax": "2"}) != base
+    assert entry_key("b" * 64, (8, 4), "float32", env) != base
+    assert entry_key("a" * 64, (8, 4), "float32", dict(env)) == base

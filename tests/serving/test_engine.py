@@ -311,3 +311,46 @@ def test_64_concurrent_requests_one_compile_per_bucket(mnist_fitted):
     assert {sig[0][0] for sig in engine.compiled_signatures} == set(buckets)
     # and the shared pipeline's own compiled state was never touched
     assert fitted.compile_count == 0
+
+
+# ---------------------------------------------------------------------------
+# warm-up contract: required-vs-best-effort + the fit-time datum hint
+# ---------------------------------------------------------------------------
+
+
+def test_warm_up_raises_when_explicitly_requested_but_impossible():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,))  # no shape anywhere
+    with pytest.raises(ValueError, match="warm-up requested but impossible"):
+        engine.warm_up()
+    with pytest.raises(ValueError, match="warm-up requested but impossible"):
+        engine.start(warmup=True)
+    # best-effort default still boots (cold, with a warning)
+    engine.start()
+    assert abs(engine.predict(np.ones(2), timeout=30.0) - 4.0) < 1e-6
+    engine.shutdown()
+
+
+def test_datum_shape_recorded_at_fit_flows_into_the_engine(mnist_fitted):
+    """and_then(estimator, data) records the per-item input contract on
+    the FittedPipeline; an engine constructed WITHOUT datum_shape warms
+    up from it instead of silently returning 0 buckets."""
+    fitted, data = mnist_fitted
+    assert fitted.datum_shape == (784,)
+    assert fitted.datum_dtype == "float32"
+    engine = ServingEngine(fitted, buckets=(8,))
+    assert engine.policy.datum_shape == (784,)
+    assert engine.warm_up() == 1  # required=True default: must not skip
+    engine.start(warmup=False)
+    preds = [engine.predict(row, timeout=60.0) for row in data[:4]]
+    engine.shutdown()
+    expected = np.asarray(fitted.apply(data[:4]).to_array())
+    np.testing.assert_array_equal(np.asarray(preds).ravel(), expected.ravel())
+
+
+def test_datum_hint_survives_pickle(mnist_fitted):
+    from keystone_tpu.utils import serialization
+
+    fitted, _data = mnist_fitted
+    clone = serialization.loads(serialization.dumps(fitted))
+    assert clone.datum_shape == (784,)
+    assert clone.datum_dtype == "float32"
