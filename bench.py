@@ -2183,6 +2183,203 @@ def bench_serve_cold_start() -> dict:
     }
 
 
+def bench_sharded_scan() -> dict:
+    """Mesh-distributed out-of-core scans (data/pipeline_scan.py lanes +
+    parallel/lanes.py): weak-scaling rows over virtual device counts
+    {1, 2, 4, 8} for a streaming normal-equations fit whose chunks
+    round-robin across per-device staging lanes with per-lane Gram
+    partials reduced once at finalize.
+
+    Per row: wall clock (pipelined and serial), measured overlap fraction
+    (chunk_pipeline's method: (t_serial − t_pipe) / min(t_host, t_dev)),
+    and the per-scan collective count at 1x AND 2x the chunk count — the
+    PAPERS.md #3 gate: cross-mesh accumulator traffic must be O(1) per
+    scan (O(blocks) for BCD), never O(chunks). The chunk stream the
+    consumer sees is digest-compared bit-equal across device counts, and
+    the fitted weights must agree with the 1-device fit to 1e-6.
+
+    Each row runs in a subprocess (device count must be set before
+    backend init). Virtual devices share the container's 2 cores, so wall
+    clock cannot stay flat as lanes grow compute; the chunk producer's
+    I/O-stall stand-in (sleep) is what genuinely overlaps here, and the
+    honest scaling metric is shared-core efficiency as in weak_scaling."""
+    import json as _json
+    import subprocess
+    import sys
+
+    script = r"""
+import json, sys, time, os, hashlib
+from keystone_tpu.parallel.virtual import provision_virtual_devices, provision_from_env
+ndev = int(sys.argv[1])
+# unconditional: an inherited KEYSTONE_VIRTUAL_DEVICES must not override
+# the per-row device count (all rows would silently measure one mesh)
+os.environ["KEYSTONE_VIRTUAL_DEVICES"] = str(ndev)
+provision_from_env()
+import numpy as np, jax, jax.numpy as jnp
+from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+from keystone_tpu.parallel.lanes import scan_lanes
+from keystone_tpu.data.pipeline_scan import scan_pipeline
+from keystone_tpu.linalg import solve_least_squares_streaming
+from keystone_tpu.obs import SCAN_SPAN, Tracer, install
+from keystone_tpu.obs import tracer as trace_mod
+
+n_chunks, rows, d, k = 12, 1024, 64, 4
+
+def host_chunk(i):
+    # host production with an I/O-stall stand-in: on 2 shared vCPUs only
+    # blocking time genuinely overlaps device work (tar decode / disk
+    # reads in real pipelines)
+    rng = np.random.default_rng(500 + (i % n_chunks))
+    A = np.tanh(rng.standard_normal((rows, d)).astype(np.float32))
+    y = rng.standard_normal((rows, k)).astype(np.float32)
+    time.sleep(0.004)
+    return A, y
+
+def src(m=1):
+    return (host_chunk(i) for i in range(n_chunks * m))
+
+with use_mesh(make_mesh(n_data=ndev, n_model=1)):
+    lanes = scan_lanes()
+
+    # chunk stream the consumer sees: bit-equality across device counts
+    h = hashlib.sha256()
+    for A, y in scan_pipeline(src(), lanes=lanes, label="digest"):
+        h.update(np.asarray(A).tobytes()); h.update(np.asarray(y).tobytes())
+    digest = h.hexdigest()
+
+    def fit(m=1):
+        return solve_least_squares_streaming(src(m), reg=0.5, lanes=lanes)
+
+    W = jax.block_until_ready(fit())  # warm: compiles every lane program
+
+    t0 = time.perf_counter()
+    for i in range(n_chunks):
+        host_chunk(i)
+    t_host = time.perf_counter() - t0
+
+    staged = [(jnp.asarray(A), jnp.asarray(y)) for A, y in src()]
+    t0 = time.perf_counter()
+    jax.block_until_ready(solve_least_squares_streaming(iter(staged), reg=0.5, lanes=lanes))
+    t_dev = time.perf_counter() - t0
+    del staged
+
+    def timed():
+        t0 = time.perf_counter()
+        jax.block_until_ready(fit())
+        return time.perf_counter() - t0
+
+    os.environ["KEYSTONE_SCAN_PIPELINE"] = "0"
+    t_serial = min(timed() for _ in range(2))
+    os.environ["KEYSTONE_SCAN_PIPELINE"] = "1"
+    t_pipe = min(timed() for _ in range(2))
+
+    def collectives(m):
+        tracer = install(Tracer())
+        try:
+            jax.block_until_ready(fit(m))
+            spans = [s for s in tracer.spans() if s.name == SCAN_SPAN
+                     and s.attrs["label"] == "normal_eq"]
+            return sum(s.attrs.get("collectives", 0) for s in spans)
+        finally:
+            trace_mod.reset()
+
+    coll_1x, coll_2x = collectives(1), collectives(2)
+
+overlap = (t_serial - t_pipe) / max(min(t_host, t_dev), 1e-9)
+print(json.dumps({
+    "ndev": ndev, "lanes": lanes, "n_chunks": n_chunks,
+    "seconds_pipelined": round(t_pipe, 3),
+    "seconds_serial": round(t_serial, 3),
+    "seconds_host_only": round(t_host, 3),
+    "seconds_device_only": round(t_dev, 3),
+    "overlap_fraction": round(max(0.0, min(1.0, overlap)), 3),
+    "collectives_1x_chunks": coll_1x,
+    "collectives_2x_chunks": coll_2x,
+    "chunk_digest": digest,
+    "W": np.asarray(W).ravel().tolist(),
+}))
+"""
+    rows = []
+    for ndev in (1, 2, 4, 8):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", script, str(ndev)],
+                capture_output=True, text=True, timeout=300,
+            )
+            if proc.returncode != 0 or not proc.stdout.strip():
+                rows.append({
+                    "ndev": ndev,
+                    "error": (proc.stderr or "no output")[-300:],
+                })
+                continue
+            rows.append(_json.loads(proc.stdout.strip().splitlines()[-1]))
+        except Exception as e:  # record the failure, don't kill the bench
+            rows.append({"ndev": ndev, "error": str(e)[:300]})
+    ok = [r for r in rows if "W" in r]
+    out_rows = []
+    base = ok[0] if ok else None
+    checks = {}
+    if base is not None:
+        W0 = base["W"]
+        checks["chunk_stream_bit_equal_ok"] = all(
+            r["chunk_digest"] == base["chunk_digest"] for r in ok
+        )
+        max_dev = max(
+            max(abs(a - b) for a, b in zip(r["W"], W0)) for r in ok
+        )
+        checks["fit_max_dev_vs_1dev"] = float(f"{max_dev:.2e}")
+        checks["fit_parity_1e6_ok"] = bool(max_dev <= 1e-6)
+        checks["collectives_chunk_independent_ok"] = all(
+            r["collectives_1x_chunks"] == r["collectives_2x_chunks"]
+            for r in ok
+        )
+        checks["single_device_zero_collectives_ok"] = (
+            base["collectives_1x_chunks"] == 0 if base["ndev"] == 1 else None
+        )
+        t1 = base["seconds_pipelined"]
+        effs = []
+        for r in ok:
+            eff = round(t1 / max(r["seconds_pipelined"], 1e-9), 3)
+            effs.append(eff)
+            r["shared_core_scan_efficiency"] = eff
+        # fixed total stream on shared silicon: flat seconds (eff ~ 1)
+        # means lane partitioning/collective overhead costs ~nothing. The
+        # gate is a FLOOR per step over the MULTI-lane rows — it must
+        # catch efficiency collapsing as lanes GROW (the PAPERS.md #3
+        # failure mode: per-lane overhead scaling with the mesh); getting
+        # faster is never a failure, and the 1→2 step carries the fixed
+        # partitioning cost so it is reported but not gated
+        checks["efficiency_curve"] = effs
+        checks["efficiency_monotone_ok"] = all(
+            b >= a * 0.75 for a, b in zip(effs[1:], effs[2:])
+        )
+    for r in rows:
+        out_rows.append({k: v for k, v in r.items() if k not in ("W",)})
+    return {
+        "rows": out_rows,
+        "checks": checks,
+        "note": (
+            "fixed 12-chunk (A, y) stream consumed by the sharded "
+            "streaming normal-equations fit at every virtual device "
+            "count; chunk digests prove the consumer sees a bit-equal "
+            "stream, W parity proves per-lane Gram partials + one "
+            "finalize reduce match the single-accumulator path, and the "
+            "1x-vs-2x chunk-count collective counts prove the cross-mesh "
+            "schedule is O(1) per scan (PAPERS.md #3). Virtual lanes "
+            "share 2 physical cores, so efficiency measures partitioning "
+            "overhead, not real speedup — real flat-curve scaling needs "
+            "real chips (tests/linalg/test_compiled_distribution.py "
+            "holds the compiled-artifact proofs)"
+        ),
+        "knobs": (
+            "KEYSTONE_SCAN_LANES overrides the lane count (1 = kill "
+            "switch); KEYSTONE_SCAN_DEPTH is the per-lane ring depth; "
+            "KEYSTONE_VIRTUAL_DEVICES provisions a virtual mesh from any "
+            "entry point"
+        ),
+    }
+
+
 def _section(name, fn):
     """Run one bench section with stderr progress (stdout stays pure JSON)."""
     import sys
@@ -2215,6 +2412,7 @@ def main() -> int:
     gather_parallel = _section("gather_parallel", bench_gather_parallel)
     serve_cold_start = _section("serve_cold_start", bench_serve_cold_start)
     weak_scaling = _section("weak_scaling", bench_weak_scaling)
+    sharded_scan = _section("sharded_scan", bench_sharded_scan)
     from keystone_tpu.obs import tracer as trace_mod
 
     tracer = trace_mod.current()
@@ -2256,6 +2454,7 @@ def main() -> int:
                     "gather_parallel": gather_parallel,
                     "serve_cold_start": serve_cold_start,
                     "weak_scaling_virtual_mesh": weak_scaling,
+                    "sharded_scan": sharded_scan,
                     "trace": trace_extra,
                 },
             }

@@ -14,6 +14,9 @@
 # A fourth stage compiles a fitted pipeline against a fresh AOT executable
 # cache twice (fresh process each) and asserts the cache-miss run traces
 # `aot.miss` + `aot.export` spans and the hit run traces `aot.load`.
+# A fifth stage runs a mesh-sharded streaming fit on a 4-device virtual
+# mesh and asserts the sharded scan emits per-lane spans with device
+# attribution and a per-scan `collectives` attr on the scan span.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
@@ -168,3 +171,53 @@ print(f"AOT SPANS OK ({mode}): "
       + ", ".join(sorted(n for n in set(names) if n.startswith("aot."))))
 PY
 done
+
+# -- mesh-sharded scan spans --------------------------------------------------
+shard_out="$(mktemp /tmp/keystone-shard-trace-XXXXXX.json)"
+env JAX_PLATFORMS=cpu KEYSTONE_TRACE="$shard_out" KEYSTONE_VIRTUAL_DEVICES=4 \
+  python - "$shard_out" <<'PY'
+import json
+import sys
+
+from keystone_tpu.parallel.virtual import provision_from_env
+
+provision_from_env()  # 4-device virtual mesh from KEYSTONE_VIRTUAL_DEVICES
+
+import numpy as np
+
+from keystone_tpu.utils.obs import configure, export_trace
+
+configure()
+
+import jax.numpy as jnp
+
+from keystone_tpu.linalg import solve_blockwise_l2_streaming
+from keystone_tpu.parallel.lanes import scan_lanes
+
+assert scan_lanes() == 4, scan_lanes()
+rng = np.random.default_rng(0)
+A = rng.standard_normal((96, 8)).astype(np.float32)
+y = rng.standard_normal((96, 2)).astype(np.float32)
+solve_blockwise_l2_streaming(
+    lambda: iter([A[i : i + 16] for i in range(0, 96, 16)]),
+    jnp.asarray(y), reg=0.1, block_size=4,
+    means=jnp.asarray(A.mean(axis=0)),
+)
+path = export_trace()
+assert path == sys.argv[1], (path, sys.argv[1])
+with open(path) as f:
+    doc = json.load(f)
+scans = [e for e in doc["traceEvents"] if e["name"] == "scan.pipeline"
+         and e.get("args", {}).get("label") == "bcd.stream"]
+assert scans, "no sharded scan.pipeline spans"
+for e in scans:
+    a = e["args"]
+    assert str(a["lanes"]) == "4", a
+    assert int(a["collectives"]) > 0, a  # per-block reduce+broadcast, O(blocks)
+lanes = [e for e in doc["traceEvents"] if e["name"] == "scan.pipeline.lane"]
+assert len(lanes) >= 4 * len(scans), (len(lanes), len(scans))
+devices = {str(e["args"]["device"]) for e in lanes}
+assert len(devices) == 4, devices  # per-lane device attribution
+print(f"SHARDED SCAN SPANS OK: {len(scans)} scan span(s), "
+      f"{len(lanes)} lane span(s) over {len(devices)} devices -> {path}")
+PY

@@ -191,16 +191,22 @@ class ChunkedDataset(Dataset):
     def __len__(self) -> int:
         return self._num_rows
 
-    def chunks(self) -> Iterator[Any]:
+    def chunks(self, lanes: Optional[int] = None) -> Iterator[Any]:
         """One scan: recomputes the whole lazy chain chunk-by-chunk.
 
         Runs through the pipelined scan runtime (``pipeline_scan.py``):
         the chain executes in a background producer thread while an H2D
         staging ring keeps device uploads ahead of the consumer, so host
         production, transfer, and device compute overlap on every
-        streaming consumer. ``KEYSTONE_SCAN_PIPELINE=0`` restores the
-        serial in-thread scan."""
-        return scan_pipeline(self._payload(), label=self._label)
+        streaming consumer. ``lanes`` round-robins chunks across that many
+        data-axis devices with one staging ring each (mesh-distributed
+        scan) — pass it ONLY from consumers that keep per-lane partial
+        accumulators; the default single-lane scan is what ``to_array``/
+        ``cache`` and other whole-stream consumers need.
+        ``KEYSTONE_SCAN_PIPELINE=0`` restores the serial in-thread scan."""
+        return scan_pipeline(
+            self._payload(), label=self._label, lanes=lanes or 1
+        )
 
     def raw_chunks(self) -> Iterator[Any]:
         """One scan WITHOUT the pipelined runtime — for composition sites

@@ -28,15 +28,32 @@ consumer with the original traceback attached, and early consumer exit
 ``GeneratorExit`` unwinding a wrapping generator) drains the buffer and
 joins the producer thread — no orphan threads, no deadlock.
 
+Mesh-distributed scans (``lanes > 1``): when the active mesh has a >1-wide
+data axis, consumers that keep per-device partial accumulators (the
+streaming solvers, column means, the streaming StandardScaler) request one
+staging **lane per data-axis device** — chunk ``i`` is committed to the
+device of lane ``i % lanes`` (``parallel/lanes.py``), each lane running its
+own ``depth``-deep H2D ring, so the whole mesh ingests the stream
+concurrently. The round-robin is deterministic and order is still
+preserved, so a consumer recovers a chunk's lane from its position alone.
+Lane consumers reduce their partials across the mesh once per block or
+once at finalize (``reduce_lane_partials``) and the transfer count lands on
+the scan's span as ``collectives`` — the PAPERS.md #3 gate is that this is
+O(blocks), never O(chunks). ``lanes=1`` (any 1-device mesh, or
+``KEYSTONE_SCAN_LANES=1``) is byte-identical to the single-device scan.
+
 Knobs: ``KEYSTONE_SCAN_PIPELINE=0`` is the kill switch (serial scan, the
-staging double buffer kept); ``KEYSTONE_SCAN_DEPTH`` sets the buffer and
-staging depth (default 2); ``KEYSTONE_CHUNK_BUCKETS=0`` disables ragged-
-chunk shape bucketing (:class:`ChunkPadder`); ``KEYSTONE_MAP_WORKERS``
-sizes the per-chunk item thread pool in ``ChunkedDataset.map``.
+staging double buffer kept — lane placement preserved); ``KEYSTONE_SCAN_DEPTH``
+sets the buffer and per-lane staging depth (default 2; a K-lane scan keeps
+up to ``depth x K`` chunks in flight); ``KEYSTONE_SCAN_LANES`` overrides
+the lane count; ``KEYSTONE_CHUNK_BUCKETS=0`` disables ragged-chunk shape
+bucketing (:class:`ChunkPadder`); ``KEYSTONE_MAP_WORKERS`` sizes the
+per-chunk item thread pool in ``ChunkedDataset.map``.
 
 Per-scan counters (producer-stall vs consumer-stall seconds, staged H2D
-bytes, peak buffer occupancy) land as ``scan.pipeline`` spans in the
-tracer (``obs/scan.py``) when tracing is on.
+bytes — per lane on sharded scans — peak buffer occupancy, collective
+count) land as ``scan.pipeline`` spans in the tracer (``obs/scan.py``)
+when tracing is on.
 """
 
 from __future__ import annotations
@@ -45,9 +62,9 @@ import os
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from queue import Empty, Full, Queue
-from typing import Any, Callable, Iterator, Optional, Tuple
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -101,10 +118,27 @@ def payload_nbytes(payload: Any) -> int:
     return total
 
 
-def _stage_chunk(chunk: Any) -> Tuple[Any, int]:
+def _on_device(leaf: Any, device: Any) -> bool:
+    from ..parallel.lanes import _single_device
+
+    return _single_device(leaf) == device
+
+
+def _stage_chunk(chunk: Any, device: Any = None) -> Tuple[Any, int]:
     """Issue the H2D transfer for host (numpy) chunks; device arrays and
-    non-array payloads pass through. Returns (staged_chunk, bytes_staged)."""
+    non-array payloads pass through. With a lane ``device``, every array
+    leaf is committed there — numpy via H2D, device arrays (e.g. a
+    mesh-sharded featurized chunk) via D2D gather — so a lane's partial
+    accumulators never mix devices. Returns (staged_chunk, bytes_staged)."""
     leaves = jax.tree_util.tree_leaves(chunk)
+    if device is not None:
+        movable = any(
+            isinstance(leaf, np.ndarray) or hasattr(leaf, "devices")
+            for leaf in leaves
+        )
+        if not movable or all(_on_device(leaf, device) for leaf in leaves):
+            return chunk, 0
+        return jax.device_put(chunk, device), payload_nbytes(chunk)
     if any(isinstance(leaf, np.ndarray) for leaf in leaves):
         return jax.device_put(chunk), payload_nbytes(chunk)
     return chunk, 0
@@ -128,6 +162,18 @@ class ScanStats:
     occupancy_max: int = 0
     start: float = 0.0
     end: float = 0.0
+    #: staging lanes (data-axis devices) this scan round-robins over;
+    #: 1 = the single-device path, no lane accounting
+    lanes: int = 1
+    #: chunks / staged bytes per lane (len == lanes when lanes > 1) —
+    #: skew across lanes is the straggler signal the obs audit reads
+    lane_chunks: List[int] = field(default_factory=list)
+    lane_bytes: List[int] = field(default_factory=list)
+    #: str(device) per lane, for device attribution in spans
+    lane_devices: List[str] = field(default_factory=list)
+    #: consumer-reported cross-mesh transfers (partial-accumulator
+    #: reductions + per-block model broadcasts) attributed to this scan
+    collectives: int = 0
 
 
 _CHUNK, _ERROR, _DONE = 0, 1, 2
@@ -192,19 +238,41 @@ class ScanPipeline:
         depth: Optional[int] = None,
         stage: bool = True,
         label: str = "scan",
+        lanes: int = 1,
+        devices: Optional[Sequence[Any]] = None,
     ):
         self._depth = depth or pipeline_depth()
         self._do_stage = stage
-        self._q: Queue = Queue(maxsize=self._depth)
+        self._lanes = max(1, int(lanes))
+        if self._lanes > 1 and stage:
+            if devices is None:
+                from ..parallel.lanes import lane_devices as _lane_devices
+
+                devices = _lane_devices(self._lanes)
+            self._devices: Optional[List[Any]] = list(devices)
+        else:
+            # lanes without staging is meaningless; collapse to one lane so
+            # the single-device contract (and its span schema) holds
+            self._lanes = 1
+            self._devices = None
+        self._ring = self._depth * self._lanes
+        self._seq = 0
+        self._q: Queue = Queue(maxsize=self._ring)
         self._stop = threading.Event()
         self._staged: deque = deque()
         self._source_done = False
         self._error: Optional[BaseException] = None
         self._closed = False
         self._recorded = False
+        self._span = None
         self.stats = ScanStats(
             label=label, depth=self._depth, start=time.perf_counter()
         )
+        if self._devices is not None:
+            self.stats.lanes = self._lanes
+            self.stats.lane_chunks = [0] * self._lanes
+            self.stats.lane_bytes = [0] * self._lanes
+            self.stats.lane_devices = [str(d) for d in self._devices]
         self._thread = threading.Thread(
             target=_producer_loop,
             args=(iter(source), self._q, self._stop, self.stats),
@@ -215,15 +283,34 @@ class ScanPipeline:
 
     # -- consumer ---------------------------------------------------------
 
+    @property
+    def lanes(self) -> int:
+        """Staging lane count; chunk ``i`` lives on lane ``i % lanes``."""
+        return self._lanes
+
+    @property
+    def lane_devices(self) -> Optional[List[Any]]:
+        """Per-lane devices (None on single-lane scans)."""
+        return self._devices
+
+    def record_collectives(self, n: int) -> None:
+        """Consumer-reported cross-mesh transfers (per-lane partial
+        reductions, per-block model broadcasts) attributed to this scan.
+        Works before or after exhaustion — a finalize-time reduction still
+        lands on the already-recorded span."""
+        self.stats.collectives += int(n)
+        if self._span is not None:
+            self._span.attrs["collectives"] = self.stats.collectives
+
     def __iter__(self) -> "ScanPipeline":
         return self
 
     def __next__(self) -> Any:
         if self._closed:
             raise StopIteration
-        # top up the staging ring so `depth` H2D transfers are in flight
-        # while the caller computes on the chunk we hand back
-        while not self._source_done and len(self._staged) < self._depth:
+        # top up the staging rings so `depth` H2D transfers per lane are in
+        # flight while the caller computes on the chunk we hand back
+        while not self._source_done and len(self._staged) < self._ring:
             if self._staged:
                 try:
                     kind, payload = self._q.get_nowait()
@@ -240,10 +327,16 @@ class ScanPipeline:
                 self._error = payload
             else:
                 if self._do_stage:
-                    chunk, nbytes = _stage_chunk(payload)
+                    lane = self._seq % self._lanes
+                    dev = self._devices[lane] if self._devices else None
+                    chunk, nbytes = _stage_chunk(payload, dev)
                     self.stats.staged_bytes += nbytes
+                    if self._devices is not None:
+                        self.stats.lane_chunks[lane] += 1
+                        self.stats.lane_bytes[lane] += nbytes
                 else:
                     chunk = payload
+                self._seq += 1
                 self._staged.append(chunk)
         if self._staged:
             self.stats.chunks += 1
@@ -303,7 +396,9 @@ class ScanPipeline:
         try:
             from ..obs.scan import record_scan_span
 
-            record_scan_span(self.stats)
+            # keep the span handle: finalize-time collective counts are
+            # stamped onto it after exhaustion (record_collectives)
+            self._span = record_scan_span(self.stats)
         except Exception:
             pass
 
@@ -321,20 +416,38 @@ class ScanPipeline:
         self.close()
 
 
-def serial_staged(chunks: Any, depth: int = DEFAULT_DEPTH):
+def serial_staged(
+    chunks: Any,
+    depth: int = DEFAULT_DEPTH,
+    lanes: int = 1,
+    devices: Optional[Sequence[Any]] = None,
+):
     """The no-thread fallback (and the old ``prefetch_to_device`` body):
-    iterate ``chunks`` with up to ``depth`` device uploads in flight.
-    Host (numpy) chunks are ``jax.device_put`` ahead of the consumer so
-    the H2D transfer streams while the previous chunk's compute runs;
-    device arrays pass through untouched. Order is preserved."""
+    iterate ``chunks`` with up to ``depth`` device uploads in flight per
+    lane. Host (numpy) chunks are ``jax.device_put`` ahead of the consumer
+    so the H2D transfer streams while the previous chunk's compute runs;
+    device arrays pass through untouched (single-lane) or gather to their
+    lane's device (``lanes > 1`` — the round-robin placement contract must
+    hold even with the producer thread killed, so lane consumers stay
+    correct under KEYSTONE_SCAN_PIPELINE=0). Order is preserved."""
+    lanes = max(1, int(lanes))
+    if lanes > 1 and devices is None:
+        from ..parallel.lanes import lane_devices as _lane_devices
+
+        devices = _lane_devices(lanes)
     q: deque = deque()
     it = iter(chunks)
+    seq = 0
     while True:
-        while it is not None and len(q) < depth:
+        while it is not None and len(q) < depth * lanes:
             try:
-                q.append(_stage_chunk(next(it))[0])
+                chunk = next(it)
             except StopIteration:
                 it = None
+                break
+            dev = devices[seq % lanes] if devices is not None else None
+            q.append(_stage_chunk(chunk, dev)[0])
+            seq += 1
         if not q:
             return
         yield q.popleft()
@@ -346,39 +459,56 @@ def scan_pipeline(
     depth: Optional[int] = None,
     stage: bool = True,
     label: str = "scan",
+    lanes: int = 1,
+    devices: Optional[Sequence[Any]] = None,
 ):
     """THE streaming-scan entry point: wrap any chunk iterable in the
     pipelined runtime. Idempotent (an already-pipelined iterator passes
-    through, so solver sites can wrap ``dataset.chunks()`` blindly without
-    stacking threads). ``stage=False`` skips the H2D staging ring for
-    consumers that want host chunks. With ``KEYSTONE_SCAN_PIPELINE=0``
-    this degrades to the serial :func:`serial_staged` double buffer."""
+    through — including its lane layout, so callers must read the
+    effective count off ``.lanes`` — and solver sites can wrap
+    ``dataset.chunks()`` blindly without stacking threads). ``stage=False``
+    skips the H2D staging ring for consumers that want host chunks.
+    ``lanes > 1`` round-robins chunks across the data-axis devices (see
+    the module docstring); only consumers that keep per-lane partial
+    accumulators should ask for it. With ``KEYSTONE_SCAN_PIPELINE=0``
+    this degrades to the serial :func:`serial_staged` buffer, lane
+    placement preserved."""
     if isinstance(chunks, ScanPipeline):
         return chunks
     if not pipeline_enabled():
         if stage:
-            return serial_staged(chunks, depth or pipeline_depth())
+            return serial_staged(
+                chunks, depth or pipeline_depth(), lanes=lanes, devices=devices
+            )
         return iter(chunks)
-    return ScanPipeline(chunks, depth=depth, stage=stage, label=label)
+    return ScanPipeline(
+        chunks, depth=depth, stage=stage, label=label, lanes=lanes,
+        devices=devices,
+    )
 
 
 # -- chunk-shape bucketing ---------------------------------------------------
 
 
-def bucket_ladder(lead_rows: int, levels: int = 4) -> Tuple[int, ...]:
+def bucket_ladder(
+    lead_rows: int, levels: int = 4, multiple: int = 1
+) -> Tuple[int, ...]:
     """Bucket row counts for a scan whose lead chunk has ``lead_rows``:
     ``{ceil(lead/2^i) for i < levels}``, ascending. A ragged tail pads to
     the next bucket up (at most ~2× its own rows of wasted compute,
     bounded by lead/2^(levels-1) pad rows), and a fused chain compiles at
-    most ``levels`` times per scan instead of once per distinct shape."""
-    return tuple(
-        sorted(
-            {
-                max(1, (lead_rows + (1 << i) - 1) >> i)
-                for i in range(max(1, levels))
-            }
-        )
-    )
+    most ``levels`` times per scan instead of once per distinct shape.
+
+    ``multiple`` rounds every bucket UP to a multiple (collapsing rungs
+    that collide) — the mesh-sharded fused-chain path needs every bucket
+    divisible by the data-axis size so the per-chunk program can span the
+    mesh: a 7-row tail on a 4-device axis must pad to 8, not 7."""
+    vals = {
+        max(1, (lead_rows + (1 << i) - 1) >> i) for i in range(max(1, levels))
+    }
+    if multiple > 1:
+        vals = {((v + multiple - 1) // multiple) * multiple for v in vals}
+    return tuple(sorted(vals))
 
 
 class ChunkPadder:
@@ -394,13 +524,66 @@ class ChunkPadder:
     axis (true for fused transformer chains; batch-coupled nodes are
     rejected upstream). The ladder locks on the first chunk and is shared
     across scans, so re-scans (lineage recompute) reuse the compiles.
-    ``KEYSTONE_CHUNK_BUCKETS=0`` makes this a transparent pass-through."""
+    ``KEYSTONE_CHUNK_BUCKETS=0`` makes this a transparent pass-through.
 
-    def __init__(self, fn: Callable[[Any], Any], levels: int = 4):
+    Mesh-sharded scans: bucket targets round up to a ``multiple`` of the
+    data-axis lane count (default: the active mesh's, via
+    ``parallel.lanes.scan_lanes``) so every padded chunk divides evenly
+    over the mesh, and ``shard=True`` commits the padded chunk with
+    ``batch_sharding`` before calling ``fn`` — the fused program then
+    computes SPMD across the whole mesh per chunk instead of on one
+    device. A 1-lane mesh keeps both knobs inert (today's exact path)."""
+
+    def __init__(
+        self,
+        fn: Callable[[Any], Any],
+        levels: int = 4,
+        multiple: Optional[int] = None,
+        shard: bool = False,
+    ):
         self.fn = fn
         self.levels = levels
+        self.multiple = multiple
+        self.shard = shard
         self._buckets: Optional[Tuple[int, ...]] = None
+        self._resolved_multiple = 1
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _lane_multiple() -> int:
+        try:
+            from ..parallel.lanes import scan_lanes
+
+            return scan_lanes()
+        except Exception:
+            return 1
+
+    def _run(self, chunk: Any, rows: int) -> Any:
+        """Invoke ``fn``, committing the chunk mesh-sharded first when the
+        sharded path is on and the (padded) row count divides the FULL
+        data axis — ``batch_sharding`` spans every data-axis device, so a
+        KEYSTONE_SCAN_LANES narrower than the axis (lane multiple < axis
+        width) must fall back to the unsharded call rather than hand XLA
+        an indivisible dim."""
+        if self.shard and self._resolved_multiple > 1:
+            from ..parallel.mesh import (
+                DATA_AXIS,
+                batch_sharding,
+                default_mesh,
+            )
+
+            mesh = default_mesh()
+            if rows % int(mesh.shape[DATA_AXIS]) != 0:
+                return self.fn(chunk)
+
+            def place(a):
+                nd = getattr(a, "ndim", None)
+                if not nd:  # scalars / non-arrays pass through
+                    return a
+                return jax.device_put(a, batch_sharding(mesh, nd))
+
+            chunk = jax.tree_util.tree_map(place, chunk)
+        return self.fn(chunk)
 
     def __call__(self, chunk: Any) -> Any:
         if not bucketing_enabled():
@@ -409,16 +592,22 @@ class ChunkPadder:
         if self._buckets is None:
             with self._lock:
                 if self._buckets is None:
-                    self._buckets = bucket_ladder(rows, self.levels)
+                    m = self.multiple
+                    if m is None:
+                        m = self._lane_multiple()
+                    self._resolved_multiple = max(1, int(m))
+                    self._buckets = bucket_ladder(
+                        rows, self.levels, multiple=self._resolved_multiple
+                    )
         target = next((b for b in self._buckets if b >= rows), None)
         if target is None or target == rows:
             # at-or-above the lead shape: run unpadded (a growing source
             # compiles per such shape, exactly as before)
-            return self.fn(chunk)
+            return self._run(chunk, rows)
         padded = jax.tree_util.tree_map(
             lambda a: _pad_rows(a, rows, target), chunk
         )
-        out = self.fn(padded)
+        out = self._run(padded, target)
         return jax.tree_util.tree_map(lambda a: a[:rows], out)
 
 

@@ -15,7 +15,7 @@ from .bcd import (
     solve_blockwise_l2_streaming,
     stream_column_means,
 )
-from .tsqr import tsqr_r
+from .tsqr import tsqr_r, tsqr_r_streaming
 
 __all__ = [
     "RowShardedMatrix",
@@ -31,4 +31,5 @@ __all__ = [
     "solve_blockwise_l2_streaming",
     "stream_column_means",
     "tsqr_r",
+    "tsqr_r_streaming",
 ]

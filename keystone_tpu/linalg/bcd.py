@@ -260,6 +260,7 @@ def solve_blockwise_l2_streaming(
     num_iter: int = 1,
     dtype=jnp.float32,
     means: Optional[jax.Array] = None,
+    lanes: Optional[int] = None,
 ) -> List[jax.Array]:
     """BCD least squares over a design matrix that NEVER materializes.
 
@@ -283,7 +284,21 @@ def solve_blockwise_l2_streaming(
     ``y_zm``: (n, k) pre-centered labels, resident. ``means``: (d,) column
     means (compute with :func:`stream_column_means`), or None for no
     centering. Returns the per-block weight list.
+
+    Mesh-distributed (``lanes`` from the data-axis size of the active
+    mesh; ``KEYSTONE_SCAN_LANES`` overrides): chunks round-robin across
+    per-device staging lanes, each chunk's prediction slab and label slice
+    live resident on its lane's chip, and every lane folds its own
+    Gram/cross partials per block step — the mesh reduces ONCE per block
+    (plus a per-block model broadcast to the lanes), so cross-mesh traffic
+    is O(blocks · lanes), independent of the chunk count (the PAPERS.md #3
+    gate). ``lanes=1`` runs the original single-accumulator loop,
+    bit-identical.
     """
+    from ..parallel.lanes import scan_lanes
+
+    if lanes is None:
+        lanes = scan_lanes()
     y_zm = jnp.asarray(y_zm, dtype=dtype)
     n, k = y_zm.shape
     starts: List[int] = []
@@ -314,6 +329,12 @@ def solve_blockwise_l2_streaming(
     if means is None:
         means = jnp.zeros((d,), dtype=dtype)
     means = jnp.asarray(means, dtype=dtype).reshape(d)
+
+    if lanes > 1:
+        return _solve_blockwise_l2_streaming_lanes(
+            chunk_scan, y_zm, reg, starts, sizes, num_iter, dtype, means,
+            lanes,
+        )
 
     Ws = [jnp.zeros((sz, k), dtype=dtype) for sz in sizes]
     grams: List[Optional[jax.Array]] = [None] * nblocks
@@ -363,19 +384,203 @@ def solve_blockwise_l2_streaming(
     return Ws
 
 
-def stream_column_means(chunk_scan, dtype=jnp.float32):
+def _lane_chunk_update_impl(
+    A_chunk, pred_c, G, c, W_cur, delta_prev, means, y_c,
+    jprev, jcur, *, cur_size, prev_size, do_prev, do_gram,
+):
+    """One chunk of one MESH-SHARDED streaming BCD block step — entirely
+    lane-local: applies the previous block's delayed prediction update to
+    this chunk's resident prediction slab, then folds the lane's Gram and
+    cross partials against it. No cross-device traffic here — the mesh
+    reduces once per block, after the scan. ``G`` is a (1, 1) dummy when
+    ``do_gram`` is False (the cached reduced Gram lives on the solve
+    device and must not be shipped per chunk)."""
+    if do_prev:
+        Ap = jax.lax.dynamic_slice_in_dim(A_chunk, jprev, prev_size, axis=1)
+        Ap = Ap - jax.lax.dynamic_slice_in_dim(means, jprev, prev_size)
+        pred_c = pred_c + _mm(Ap, delta_prev)
+    Ac = jax.lax.dynamic_slice_in_dim(A_chunk, jcur, cur_size, axis=1)
+    Ac = Ac - jax.lax.dynamic_slice_in_dim(means, jcur, cur_size)
+    r = y_c - pred_c + _mm(Ac, W_cur)
+    if do_gram:
+        G = G + _mm(Ac.T, Ac)
+    c = c + _mm(Ac.T, r)
+    return pred_c, G, c
+
+
+_lane_chunk_update_donating = jax.jit(
+    _lane_chunk_update_impl,
+    static_argnames=("cur_size", "prev_size", "do_prev", "do_gram"),
+    donate_argnums=(1, 2, 3),
+)
+_lane_chunk_update_plain = jax.jit(
+    _lane_chunk_update_impl,
+    static_argnames=("cur_size", "prev_size", "do_prev", "do_gram"),
+)
+
+
+def _lane_chunk_update(*args, **kwargs):
+    if jax.default_backend() == "cpu":
+        return _lane_chunk_update_plain(*args, **kwargs)
+    return _lane_chunk_update_donating(*args, **kwargs)
+
+
+def _single_device_is(x, device) -> bool:
+    from ..parallel.lanes import _single_device
+
+    return _single_device(x) == device
+
+
+def _solve_blockwise_l2_streaming_lanes(
+    chunk_scan, y_zm, reg, starts, sizes, num_iter, dtype, means, lanes
+) -> List[jax.Array]:
+    """The mesh-distributed body of :func:`solve_blockwise_l2_streaming`.
+
+    Residency: chunk *i*'s prediction slab and label slice are committed to
+    lane ``i % lanes``'s device on the FIRST scan and stay there for the
+    whole fit, so every per-chunk program is single-device local. Per block
+    step: the block model (and previous block's delta) broadcasts to each
+    lane once, each lane folds its own Gram/cross partials over its chunks,
+    and the partials reduce across the mesh once — the solve then runs on
+    the reduced (G, c). Collective count per scan: <= 2·lanes broadcasts +
+    <= 2·(lanes−1) reduction hops, independent of how many chunks stream.
+    """
+    from ..data.pipeline_scan import scan_pipeline
+    from ..parallel.lanes import (
+        lane_devices,
+        record_scan_collectives,
+        reduce_lane_partials,
+    )
+    from ..utils.timing import phase
+
+    n, k = y_zm.shape
+    nblocks = len(starts)
+    devs = lane_devices(lanes)
+    means_lane = [jax.device_put(means, d) for d in devs]
+    # per-chunk resident state, built on the first scan
+    pred_chunks: List[jax.Array] = []
+    y_chunks: List[jax.Array] = []
+    chunk_rows: List[int] = []
+    Ws = [jnp.zeros((sz, k), dtype=dtype) for sz in sizes]
+    grams: List[Optional[jax.Array]] = [None] * nblocks
+    delta_prev = None
+    jprev = 0
+    prev_size = sizes[0]
+    reg = jnp.asarray(reg, dtype)
+    first_scan = True
+    for _epoch in range(num_iter):
+        for b in range(nblocks):
+            do_prev = delta_prev is not None
+            do_gram = grams[b] is None
+            G_l: List[Optional[jax.Array]] = [None] * lanes
+            c_l: List[Optional[jax.Array]] = [None] * lanes
+            # per-block model broadcast: the lanes read W (and the delayed
+            # delta) replicated — counted as collectives on this scan
+            W_lane = [jax.device_put(Ws[b], d) for d in devs]
+            delta_src = (
+                delta_prev
+                if do_prev
+                else jnp.zeros((prev_size, k), dtype=dtype)
+            )
+            delta_lane = [jax.device_put(delta_src, d) for d in devs]
+            pipe = scan_pipeline(
+                chunk_scan(), label="bcd.stream", lanes=lanes, devices=devs
+            )
+            record_scan_collectives(pipe, (2 if do_prev else 1) * lanes)
+            row0 = 0
+            with phase("bcd.stream_block") as out:
+                for i, chunk in enumerate(pipe):
+                    chunk = jnp.asarray(chunk, dtype=dtype)
+                    rows = int(chunk.shape[0])
+                    lane = i % lanes
+                    if not _single_device_is(chunk, devs[lane]):
+                        # a passthrough source (caller handed an already-
+                        # pipelined/staged iterator) bypassed lane staging;
+                        # co-locate with the resident slabs or the lane
+                        # program would mix committed devices and fail
+                        chunk = jax.device_put(chunk, devs[lane])
+                    if first_scan:
+                        chunk_rows.append(rows)
+                        y_chunks.append(
+                            jax.device_put(
+                                y_zm[row0 : row0 + rows], devs[lane]
+                            )
+                        )
+                        pred_chunks.append(
+                            jax.device_put(
+                                jnp.zeros((rows, k), dtype=dtype), devs[lane]
+                            )
+                        )
+                    elif i >= len(chunk_rows) or chunk_rows[i] != rows:
+                        raise ValueError(
+                            "chunk source changed boundaries between scans "
+                            f"(chunk {i}: {rows} rows)"
+                        )
+                    if do_gram and G_l[lane] is None:
+                        G_l[lane] = jnp.zeros(
+                            (sizes[b], sizes[b]), dtype=dtype
+                        )
+                    if c_l[lane] is None:
+                        c_l[lane] = jnp.zeros((sizes[b], k), dtype=dtype)
+                    # fresh dummy per call: the Gram slot is donated, so a
+                    # shared placeholder would be consumed on first use
+                    g_arg = (
+                        G_l[lane]
+                        if do_gram
+                        else jnp.zeros((1, 1), dtype=dtype)
+                    )
+                    pred_chunks[i], g_new, c_l[lane] = _lane_chunk_update(
+                        chunk, pred_chunks[i], g_arg,
+                        c_l[lane], W_lane[lane], delta_lane[lane],
+                        means_lane[lane], y_chunks[i], jprev, starts[b],
+                        cur_size=sizes[b], prev_size=prev_size,
+                        do_prev=do_prev, do_gram=do_gram,
+                    )
+                    if do_gram:
+                        G_l[lane] = g_new
+                    row0 += rows
+                if row0 != n:
+                    raise ValueError(
+                        f"chunk source produced {row0} rows, labels have {n}"
+                    )
+                first_scan = False
+                if do_gram:
+                    grams[b] = reduce_lane_partials(G_l, scan=pipe)
+                c = reduce_lane_partials(c_l, scan=pipe)
+                if c is None:
+                    raise ValueError("empty chunk source")
+                W_new = solve_spd(grams[b], c, reg)
+                delta_prev = W_new - Ws[b]
+                Ws[b] = W_new
+                jprev = starts[b]
+                prev_size = sizes[b]
+                out.append(W_new)
+    return Ws
+
+
+def stream_column_means(chunk_scan, dtype=jnp.float32, lanes: Optional[int] = None):
     """One scan computing (column_sums / n, n) of a chunked design matrix —
-    the centering pass the streaming solvers run before accumulating."""
-    sums = None
+    the centering pass the streaming solvers run before accumulating.
+    Mesh-distributed like the solvers: per-lane partial sums, reduced
+    across the mesh once at finalize (O(1) collectives per scan)."""
+    from ..parallel.lanes import reduce_lane_partials, scan_lanes
+
+    if lanes is None:
+        lanes = scan_lanes()
+    pipe = scan_pipeline(chunk_scan(), label="column_means", lanes=lanes)
+    lanes = getattr(pipe, "lanes", lanes)
+    sums: List[Optional[jax.Array]] = [None] * lanes
     n = 0
-    for chunk in scan_pipeline(chunk_scan(), label="column_means"):
+    for i, chunk in enumerate(pipe):
         chunk = jnp.asarray(chunk, dtype=dtype)
         s = jnp.sum(chunk, axis=0)
-        sums = s if sums is None else sums + s
+        lane = i % lanes
+        sums[lane] = s if sums[lane] is None else sums[lane] + s
         n += int(chunk.shape[0])
-    if sums is None:
+    total = reduce_lane_partials(sums, scan=pipe)
+    if total is None:
         raise ValueError("empty chunk source")
-    return sums / n, n
+    return total / n, n
 
 
 def _bcd_scan_impl(A, y, reg, means, block_size, num_iter):

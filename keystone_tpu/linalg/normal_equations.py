@@ -9,6 +9,8 @@ over ICI) and solves on-device via Cholesky.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -67,7 +69,9 @@ def gram_accumulate(G, C, A_chunk, y_chunk):
     return _gram_accumulate_donating(G, C, A_chunk, y_chunk)
 
 
-def solve_least_squares_streaming(chunks, reg: float = 0.0, dtype=jnp.float32):
+def solve_least_squares_streaming(
+    chunks, reg: float = 0.0, dtype=jnp.float32, lanes: Optional[int] = None
+):
     """Exact L2 solve over an iterator of (A_chunk, y_chunk) row chunks.
 
     Returns the (d, k) solution. Parity: mlmatrix NormalEquations'
@@ -75,22 +79,41 @@ def solve_least_squares_streaming(chunks, reg: float = 0.0, dtype=jnp.float32):
     the per-partition Gram contributions become per-chunk donated updates.
     The source runs through the pipelined scan runtime so producing
     (A, y) chunk *i+1* overlaps chunk *i*'s Gram accumulation.
+
+    Mesh-distributed: with a >1-wide data axis (``parallel/lanes.py``;
+    ``KEYSTONE_SCAN_LANES`` overrides, ``lanes`` pins) chunks round-robin
+    across per-device staging lanes and each lane folds its own (G, C)
+    partials on its own chip — the treeReduce happens ONCE at finalize
+    (O(1) collectives per scan, never per chunk — the PAPERS.md #3
+    schedule gate), then the Cholesky solve runs on the reduced Gram.
+    ``lanes=1`` is the single-accumulator path, bit-identical to before.
     """
     from ..data.pipeline_scan import scan_pipeline
+    from ..parallel.lanes import reduce_lane_partials, scan_lanes
 
-    G = C = None
-    for A_chunk, y_chunk in scan_pipeline(chunks, label="normal_eq"):
+    if lanes is None:
+        lanes = scan_lanes()
+    pipe = scan_pipeline(chunks, label="normal_eq", lanes=lanes)
+    lanes = getattr(pipe, "lanes", lanes)
+    Gs = [None] * lanes
+    Cs = [None] * lanes
+    for i, (A_chunk, y_chunk) in enumerate(pipe):
         A_chunk = jnp.asarray(A_chunk, dtype=dtype)
         y_chunk = jnp.asarray(y_chunk, dtype=dtype)
         if y_chunk.ndim != 2 or A_chunk.ndim != 2:
             raise ValueError(
                 f"chunks must be 2-D (A: {A_chunk.shape}, y: {y_chunk.shape})"
             )
-        if G is None:
+        lane = i % lanes
+        if Gs[lane] is None:
             d, k = A_chunk.shape[1], y_chunk.shape[1]
-            G = jnp.zeros((d, d), dtype=dtype)
-            C = jnp.zeros((d, k), dtype=dtype)
-        G, C = gram_accumulate(G, C, A_chunk, y_chunk)
+            Gs[lane] = jnp.zeros((d, d), dtype=dtype)
+            Cs[lane] = jnp.zeros((d, k), dtype=dtype)
+        Gs[lane], Cs[lane] = gram_accumulate(
+            Gs[lane], Cs[lane], A_chunk, y_chunk
+        )
+    G = reduce_lane_partials(Gs, scan=pipe)
+    C = reduce_lane_partials(Cs, scan=pipe)
     if G is None:
         raise ValueError("no chunks")
     return solve_spd(G, C, reg)

@@ -79,3 +79,51 @@ def tsqr_r(A, mesh: Optional[Mesh] = None) -> jax.Array:
     A, _ = pad_to_multiple(jnp.asarray(A), mesh.shape[DATA_AXIS], axis=0)
     A = shard_batch(A, mesh)
     return _tsqr_fn(mesh)(A)
+
+
+@jax.jit
+def _qr_r(chunk):
+    return jnp.linalg.qr(chunk, mode="r")
+
+
+@jax.jit
+def _qr_fold(R, chunk):
+    """Fold one chunk into a running R factor: qr([R; chunk]) — the
+    sequential TSQR recurrence each lane runs locally."""
+    return jnp.linalg.qr(jnp.concatenate([R, chunk], axis=0), mode="r")
+
+
+def tsqr_r_streaming(
+    chunk_scan, dtype=jnp.float32, lanes: Optional[int] = None
+) -> jax.Array:
+    """Out-of-core TSQR: the R factor of a chunked (n, d) design matrix
+    whose rows never materialize together.
+
+    ``chunk_scan`` is a re-iterable source of (rows, d) chunks (the same
+    contract as the streaming solvers). Chunks ride the pipelined scan
+    runtime round-robined over the mesh's data-axis lanes; each lane folds
+    its chunks into a lane-local (d, d) R factor (``qr([R_l; chunk])``),
+    and the per-lane factors gather across the mesh ONCE at finalize for a
+    single stacked QR — the same one-level reduction tree as
+    :func:`tsqr_r`, with the leaves streamed. Collectives: O(1) per scan,
+    never per chunk. The result is sign-fixed like :func:`tsqr_r`, so the
+    two agree to fp tolerance."""
+    from ..data.pipeline_scan import scan_pipeline
+    from ..parallel.lanes import gather_lane_partials, scan_lanes
+
+    if lanes is None:
+        lanes = scan_lanes()
+    pipe = scan_pipeline(chunk_scan(), label="tsqr", lanes=lanes)
+    lanes = getattr(pipe, "lanes", lanes)
+    Rs: list = [None] * lanes
+    for i, chunk in enumerate(pipe):
+        chunk = jnp.asarray(chunk, dtype=dtype)
+        lane = i % lanes
+        Rs[lane] = (
+            _qr_r(chunk) if Rs[lane] is None else _qr_fold(Rs[lane], chunk)
+        )
+    parts = gather_lane_partials(Rs, scan=pipe)
+    if not parts:
+        raise ValueError("empty chunk source")
+    stacked = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    return _fix_sign(jnp.linalg.qr(stacked, mode="r"))

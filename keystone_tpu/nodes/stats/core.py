@@ -152,6 +152,17 @@ def _chunk_center_stats(X):
     return mean, jnp.sum(diff * diff, axis=0)
 
 
+def _chan_merge(a, b):
+    """Chan/Welford merge of two (n, mean, M2) column-stat triples."""
+    na, ma, sa = a
+    nb, mb, sb = b
+    tot = na + nb
+    delta = mb - ma
+    mean = ma + delta * (nb / tot)
+    m2 = sa + sb + delta * delta * (na * nb / tot)
+    return tot, mean, m2
+
+
 class StandardScalerModel(Transformer):
     """(x − mean) / std; std of None means center-only
     (parity: StandardScaler.scala:16-32)."""
@@ -196,23 +207,39 @@ class StandardScaler(Estimator):
         — per-chunk centered statistics merged Chan/Welford-style (the
         raw sum-of-squares form cancels catastrophically in f32 when
         |mean| ≫ std) instead of materializing via ``to_array()``. Host
-        chunk production overlaps the device reductions."""
-        n = 0
-        mean = m2 = None
-        for chunk in data.chunks():
+        chunk production overlaps the device reductions.
+
+        Mesh-distributed like the streaming solvers: chunks round-robin
+        across the data-axis lanes, each lane folds its own Chan triple
+        (n, mean, M2) on its own device, and the lane triples merge across
+        the mesh ONCE at finalize — O(1) collectives per scan. A 1-lane
+        mesh runs the original sequential merge, bit-identical."""
+        from ...parallel.lanes import gather_lane_partials, scan_lanes
+
+        lanes = scan_lanes()
+        it = data.chunks(lanes=lanes)
+        lanes = getattr(it, "lanes", lanes)
+        parts = [None] * lanes  # per-lane (n, mean, m2) Chan triples
+        for i, chunk in enumerate(it):
             X = jnp.asarray(chunk)
             nc = int(X.shape[0])
             mc, m2c = _chunk_center_stats(X)
-            if mean is None:
-                n, mean, m2 = nc, mc, m2c
+            lane = i % lanes
+            if parts[lane] is None:
+                parts[lane] = (nc, mc, m2c)
             else:
-                tot = n + nc
-                delta = mc - mean
-                mean = mean + delta * (nc / tot)
-                m2 = m2 + m2c + delta * delta * (n * nc / tot)
-                n = tot
-        if mean is None:
+                parts[lane] = _chan_merge(parts[lane], (nc, mc, m2c))
+        live = [p for p in parts if p is not None]
+        if not live:
             raise ValueError("empty chunked dataset")
+        # device partials hop to one chip (counts stay host), then the
+        # same Chan merge combines the lanes in deterministic lane order
+        gathered = gather_lane_partials(
+            [(mc, m2c) for _, mc, m2c in live], scan=it
+        )
+        n, mean, m2 = (live[0][0],) + tuple(gathered[0])
+        for (nc, _, _), (mc, m2c) in zip(live[1:], gathered[1:]):
+            n, mean, m2 = _chan_merge((n, mean, m2), (nc, mc, m2c))
         # sample variance (ddof=1), matching _column_stats; n==1 yields a
         # zero m2 whose std the degenerate guard maps to 1.0
         var = m2 / max(n - 1, 1)

@@ -13,11 +13,12 @@ Enable with ``KEYSTONE_TRACE=/path/trace.json`` (or the CLI's
 
 from .audit import cache_audit, log_cache_audit
 from .export import format_top_spans, to_chrome_trace, write_chrome_trace
-from .scan import SCAN_SPAN, record_scan_span
+from .scan import SCAN_LANE_SPAN, SCAN_SPAN, record_scan_span
 from .span import Span, cheap_nbytes
 from .tracer import Tracer, current, export, install, reset, start, stop, suspended
 
 __all__ = [
+    "SCAN_LANE_SPAN",
     "SCAN_SPAN",
     "Span",
     "Tracer",

@@ -11,7 +11,17 @@ span: ``seconds`` ≈ max(producer, consumer) work rather than their sum
 when the pipeline is doing its job, and the stall counters say which side
 bounded it. ``bench.py``'s ``chunk_pipeline`` extra and
 ``bin/trace-smoke.sh`` consume these spans.
-"""
+
+Mesh-distributed scans (``lanes > 1``) additionally carry the sharding
+schedule: ``lanes``, per-lane chunk/byte totals (``lane_chunks`` /
+``lane_bytes`` — skew here is the straggler signal, summarized as
+``lane_imbalance`` = max/mean staged bytes), the per-lane ``devices``,
+and ``collectives`` — the consumer-reported count of cross-mesh
+accumulator reductions and model broadcasts attributed to the scan (the
+PAPERS.md #3 gate: O(blocks), never O(chunks); finalize-time reductions
+are stamped onto the span after it is recorded). One ``scan.pipeline.lane``
+child span per lane nests under the scan span with that lane's device
+attribution, so a straggling lane is visible in the trace tree."""
 
 from __future__ import annotations
 
@@ -20,28 +30,77 @@ from .tracer import current
 
 #: the span name every pipelined scan records
 SCAN_SPAN = "scan.pipeline"
+#: per-lane child spans of a mesh-distributed scan
+SCAN_LANE_SPAN = "scan.pipeline.lane"
 
 
-def record_scan_span(stats) -> None:
-    """Record one finished scan's counters as a complete span. No-op when
-    tracing is off (the usual single ``current() is None`` check)."""
+def record_scan_span(stats):
+    """Record one finished scan's counters as a complete span (plus one
+    child span per lane on sharded scans). Returns the scan span so the
+    pipeline can stamp late collective counts, or None when tracing is
+    off (the usual single ``current() is None`` check)."""
     tracer = current()
     if tracer is None:
-        return
+        return None
+    attrs = {
+        "label": stats.label,
+        "chunks": stats.chunks,
+        "depth": stats.depth,
+        "producer_seconds": round(stats.producer_seconds, 6),
+        "producer_stall_seconds": round(stats.producer_stall_seconds, 6),
+        "consumer_stall_seconds": round(stats.consumer_stall_seconds, 6),
+        "staged_bytes": stats.staged_bytes,
+        "occupancy_max": stats.occupancy_max,
+    }
+    if stats.lanes > 1:
+        attrs.update(
+            lanes=stats.lanes,
+            collectives=stats.collectives,
+            lane_chunks=list(stats.lane_chunks),
+            lane_bytes=list(stats.lane_bytes),
+            devices=list(stats.lane_devices),
+        )
+        total = sum(stats.lane_bytes)
+        if total > 0:
+            attrs["lane_imbalance"] = round(
+                max(stats.lane_bytes) * stats.lanes / total, 3
+            )
     sp = Span(
         name=SCAN_SPAN,
         start=stats.start,
         end=stats.end,
         op_type="ScanPipeline",
-        attrs={
-            "label": stats.label,
-            "chunks": stats.chunks,
-            "depth": stats.depth,
-            "producer_seconds": round(stats.producer_seconds, 6),
-            "producer_stall_seconds": round(stats.producer_stall_seconds, 6),
-            "consumer_stall_seconds": round(stats.consumer_stall_seconds, 6),
-            "staged_bytes": stats.staged_bytes,
-            "occupancy_max": stats.occupancy_max,
-        },
+        attrs=attrs,
     )
     tracer.record_complete(sp)
+    if stats.lanes > 1:
+        for lane in range(stats.lanes):
+            child = Span(
+                name=SCAN_LANE_SPAN,
+                start=stats.start,
+                end=stats.end,
+                parent_id=sp.span_id,
+                depth=sp.depth + 1,
+                op_type="ScanPipeline",
+                attrs={
+                    "label": stats.label,
+                    "lane": lane,
+                    "device": (
+                        stats.lane_devices[lane]
+                        if lane < len(stats.lane_devices)
+                        else ""
+                    ),
+                    "chunks": (
+                        stats.lane_chunks[lane]
+                        if lane < len(stats.lane_chunks)
+                        else 0
+                    ),
+                    "staged_bytes": (
+                        stats.lane_bytes[lane]
+                        if lane < len(stats.lane_bytes)
+                        else 0
+                    ),
+                },
+            )
+            tracer.record_complete(child)
+    return sp

@@ -2,6 +2,13 @@
 Replaces Spark's executor/partition/broadcast/treeReduce machinery (SURVEY
 SS2.7) with jax.sharding over ICI/DCN."""
 
+from .lanes import (
+    gather_lane_partials,
+    lane_devices,
+    record_scan_collectives,
+    reduce_lane_partials,
+    scan_lanes,
+)
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -24,11 +31,16 @@ __all__ = [
     "batch_sharding",
     "column_sharding",
     "default_mesh",
+    "gather_lane_partials",
+    "lane_devices",
     "make_mesh",
     "mesh_n_data",
     "pad_to_multiple",
+    "record_scan_collectives",
+    "reduce_lane_partials",
     "replicate",
     "replicated_sharding",
+    "scan_lanes",
     "set_default_mesh",
     "shard_batch",
     "use_mesh",

@@ -1,0 +1,125 @@
+"""Scan-lane assignment: out-of-core chunks round-robined over the mesh.
+
+A **lane** is one device's share of a sharded out-of-core scan. Chunk ``i``
+of a K-lane scan is staged to (and consumed on) the device of lane
+``i % K`` — the data-axis row of the active mesh — with one H2D staging
+ring per lane, so a chunked fit streams into the whole mesh instead of
+parking every chunk on a single chip while the rest idle (ROADMAP: "Shard
+the whole fit end-to-end, including the out-of-core path").
+
+The collective discipline comes from the Spark-ML performance study
+(PAPERS.md #3): at this layer the collective *schedule* and stragglers —
+not FLOPs — dominate scaling. Consumers therefore keep **per-lane partial
+accumulators** (a Gram per lane, a BCD cross-term per lane, a Chan/Welford
+triple per lane) and reduce across the mesh ONCE per block or once at
+finalize via :func:`reduce_lane_partials` — never once per chunk. Every
+cross-device hop is recorded on the owning scan so the ``scan.pipeline``
+span's ``collectives`` attr is auditable (O(blocks), not O(chunks), is the
+bench gate).
+
+``KEYSTONE_SCAN_LANES`` overrides the lane count (clamped to the data-axis
+size; ``1`` disables sharded scanning). A 1-device environment always
+yields one lane — today's single-device scan path, byte-identical.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence
+
+import jax
+
+from .mesh import DATA_AXIS, default_mesh
+
+
+def scan_lanes(mesh=None) -> int:
+    """Effective lane count for sharded scans: ``KEYSTONE_SCAN_LANES`` if
+    set (clamped to [1, data-axis size]), else the data-axis size of the
+    active mesh."""
+    m = mesh if mesh is not None else default_mesh()
+    n_data = int(m.shape[DATA_AXIS])
+    raw = os.environ.get("KEYSTONE_SCAN_LANES")
+    if raw is not None:
+        try:
+            return max(1, min(int(raw), n_data))
+        except ValueError:
+            pass
+    return n_data
+
+
+def lane_devices(lanes: Optional[int] = None, mesh=None) -> List[Any]:
+    """The device owning each lane: the data-axis column of the mesh
+    (model index 0 — lane state is data-parallel; a >1-wide model axis
+    reads reduced accumulators replicated, exactly as the solvers already
+    do for their Gram blocks)."""
+    m = mesh if mesh is not None else default_mesh()
+    devs = list(m.devices[:, 0].flat) if m.devices.ndim >= 2 else list(
+        m.devices.flat
+    )
+    k = lanes if lanes is not None else scan_lanes(m)
+    return [devs[i % len(devs)] for i in range(k)]
+
+
+def _single_device(leaf: Any):
+    """The one device ``leaf`` is committed to, else None (numpy/host
+    values, uncommitted arrays, mesh-sharded arrays)."""
+    devices = getattr(leaf, "devices", None)
+    if devices is None or not callable(devices):
+        return None
+    try:
+        ds = devices()
+    except Exception:
+        return None
+    return next(iter(ds)) if len(ds) == 1 else None
+
+
+def record_scan_collectives(scan: Any, n: int) -> None:
+    """Attribute ``n`` cross-mesh transfers (partial reductions, model
+    broadcasts) to ``scan`` when it is a ScanPipeline; no-op for plain
+    iterators (the KEYSTONE_SCAN_PIPELINE=0 fallback)."""
+    rec = getattr(scan, "record_collectives", None)
+    if rec is not None and n:
+        rec(n)
+
+
+def gather_lane_partials(
+    partials: Sequence[Any], scan: Any = None
+) -> List[Any]:
+    """Move every non-None per-lane partial (a pytree) onto the first
+    partial's device, in lane order. Returns the gathered list; transfers
+    are counted as collectives on ``scan``. Partials already resident (or
+    host/uncommitted values) move for free and are not counted."""
+    parts = [p for p in partials if p is not None]
+    if len(parts) <= 1:
+        return parts
+    lead = jax.tree_util.tree_leaves(parts[0])
+    target = _single_device(lead[0]) if lead else None
+    out = [parts[0]]
+    moved = 0
+    for p in parts[1:]:
+        leaves = jax.tree_util.tree_leaves(p)
+        if (
+            target is not None
+            and leaves
+            and _single_device(leaves[0]) != target
+        ):
+            p = jax.device_put(p, target)
+            moved += 1
+        out.append(p)
+    record_scan_collectives(scan, moved)
+    return out
+
+
+def reduce_lane_partials(partials: Sequence[Any], scan: Any = None):
+    """Sum per-lane partial accumulators (pytrees) onto one device — the
+    once-per-block / once-per-finalize cross-mesh reduction of a sharded
+    scan. Lane order is deterministic, so the reduction is reproducible
+    run-to-run at a given lane count. Returns None when every partial is
+    None (an empty scan)."""
+    parts = gather_lane_partials(partials, scan)
+    if not parts:
+        return None
+    total = parts[0]
+    for p in parts[1:]:
+        total = jax.tree_util.tree_map(lambda a, b: a + b, total, p)
+    return total

@@ -9,6 +9,7 @@ Used by tests/conftest.py (fixed 8-device mesh for the suite) and by
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 _COUNT_FLAG = "xla_force_host_platform_device_count"
 
@@ -57,3 +58,22 @@ def provision_virtual_devices(n_devices: int) -> None:
             f"could not provision {n_devices} virtual CPU devices "
             f"(have {len(jax.devices())})"
         )
+
+
+def provision_from_env(default: Optional[int] = None) -> int:
+    """Provision ``KEYSTONE_VIRTUAL_DEVICES`` virtual CPU devices (or
+    ``default`` when the env var is unset) when more than one is asked for
+    — lets a 2-vCPU container exercise an 8-lane mesh scan from any entry
+    point (bench subprocesses, ad-hoc repros) without editing code.
+    Returns the provisioned count; 1 means no-op (real backend kept)."""
+    raw = os.environ.get("KEYSTONE_VIRTUAL_DEVICES")
+    n = default
+    if raw is not None:
+        try:
+            n = int(raw)
+        except ValueError:
+            pass
+    if n is not None and n > 1:
+        provision_virtual_devices(n)
+        return n
+    return 1

@@ -161,13 +161,21 @@ class FusedTransformerOperator(TransformerOperator):
             # of once per distinct chunk shape (serving/batching.py's trick
             # applied to out-of-core scans). The padder is captured by the
             # lazy factory, so lineage re-scans reuse the same compiles.
+            # shard=True: on a >1-wide data axis the padder rounds every
+            # bucket to a lane multiple and commits the padded chunk with
+            # batch_sharding before the call, so the fused program computes
+            # SPMD across the whole mesh per chunk — featurization spans
+            # the chips, not just the solver (ROADMAP "shard the whole fit
+            # end-to-end"). A 1-lane mesh keeps this inert.
             from ..data.pipeline_scan import ChunkPadder
 
             fn = self._jitted()
             if len(datasets) == 1:
-                return datasets[0].map_batch(ChunkPadder(fn))
+                return datasets[0].map_batch(ChunkPadder(fn, shard=True))
             zipped = align_and_zip(datasets)
-            return zipped.map_batch(ChunkPadder(lambda t: fn(*t)))
+            return zipped.map_batch(
+                ChunkPadder(lambda t: fn(*t), shard=True)
+            )
         if all(ds.is_batched for ds in datasets):
             arrays = [ds.to_array() for ds in datasets]
             return Dataset(self._jitted()(*arrays), batched=True)

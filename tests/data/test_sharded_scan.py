@@ -1,0 +1,271 @@
+"""Mesh-distributed scan pipeline: lane round-robin placement, per-lane
+staging rings and byte accounting (straggler detection), ragged-tail
+bucket rounding to the lane multiple, collectives/span attribution, and
+the mesh-of-1 fallback (data/pipeline_scan.py + parallel/lanes.py).
+Runs on the suite's 8-device virtual CPU mesh (tests/conftest.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data.pipeline_scan import (
+    ChunkPadder,
+    ScanPipeline,
+    bucket_ladder,
+    scan_pipeline,
+    serial_staged,
+)
+from keystone_tpu.parallel.lanes import (
+    lane_devices,
+    reduce_lane_partials,
+    scan_lanes,
+)
+
+
+def _chunks(n=8, rows=4, d=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((rows, d)).astype(np.float32) for _ in range(n)]
+
+
+# -- lane resolution ----------------------------------------------------------
+
+
+def test_scan_lanes_defaults_to_data_axis_size():
+    assert scan_lanes() == 8  # conftest provisions an 8-device mesh
+
+
+def test_scan_lanes_env_override_and_clamp(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_SCAN_LANES", "4")
+    assert scan_lanes() == 4
+    monkeypatch.setenv("KEYSTONE_SCAN_LANES", "1")
+    assert scan_lanes() == 1  # the sharded-scan kill switch
+    monkeypatch.setenv("KEYSTONE_SCAN_LANES", "64")
+    assert scan_lanes() == 8  # clamped to the data-axis size
+
+
+# -- lane round-robin placement ----------------------------------------------
+
+
+def test_lane_round_robin_places_chunk_i_on_lane_i_mod_k():
+    devs = lane_devices(4)
+    chunks = _chunks(8)
+    it = scan_pipeline(iter(chunks), lanes=4, label="t")
+    assert isinstance(it, ScanPipeline) and it.lanes == 4
+    for i, c in enumerate(it):
+        assert c.devices() == {devs[i % 4]}, (i, c.devices())
+        np.testing.assert_array_equal(np.asarray(c), chunks[i])
+    assert it.stats.lane_chunks == [2, 2, 2, 2]
+    assert it.stats.lane_devices == [str(d) for d in devs]
+
+
+def test_serial_fallback_preserves_lane_placement(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_SCAN_PIPELINE", "0")
+    devs = lane_devices(4)
+    chunks = _chunks(8)
+    it = scan_pipeline(iter(chunks), lanes=4, label="t")
+    assert not isinstance(it, ScanPipeline)
+    for i, c in enumerate(it):
+        assert c.devices() == {devs[i % 4]}, (i, c.devices())
+        np.testing.assert_array_equal(np.asarray(c), chunks[i])
+
+
+def test_lane_staging_gathers_committed_device_chunks():
+    # a featurized chunk already committed elsewhere (e.g. mesh-sharded by
+    # the fused chain) must still land on its lane's device
+    devs = lane_devices(2)
+    src = [jax.device_put(c, devs[1]) for c in _chunks(4)]
+    it = scan_pipeline(iter(src), lanes=2, label="t")
+    placed = list(it)
+    assert placed[0].devices() == {devs[0]}  # gathered D2D to lane 0
+    assert placed[1].devices() == {devs[1]}  # already home: passthrough
+    assert it.stats.lane_chunks == [2, 2]
+    # lane 1 chunks were already resident — no bytes counted for them
+    assert it.stats.lane_bytes[0] > 0 and it.stats.lane_bytes[1] == 0
+
+
+def test_single_lane_scan_keeps_todays_contract():
+    it = scan_pipeline(iter(_chunks(3)), label="t")
+    assert it.lanes == 1 and it.lane_devices is None
+    out = list(it)
+    assert len(out) == 3
+    # single-lane stats carry no lane schema (span stays the old shape)
+    assert it.stats.lanes == 1
+    assert it.stats.lane_chunks == [] and it.stats.lane_bytes == []
+    assert it.stats.collectives == 0
+
+
+def test_per_lane_ring_keeps_depth_chunks_in_flight_per_lane():
+    # a 2-lane depth-2 scan may stage up to depth*lanes chunks ahead
+    it = scan_pipeline(iter(_chunks(12)), depth=2, lanes=2, label="t")
+    next(it)
+    assert len(it._staged) <= 4
+    list(it)
+
+
+# -- straggler / byte accounting ---------------------------------------------
+
+
+def test_lane_bytes_expose_skewed_chunk_sizes():
+    """Deliberately skewed chunk sizes: lane 0 receives the fat chunks, so
+    its staged-byte total must dominate and the span's imbalance attr must
+    say so (satellite: the obs audit can spot lane stragglers)."""
+    from keystone_tpu.obs import SCAN_LANE_SPAN, SCAN_SPAN, Tracer, install
+    from keystone_tpu.obs import tracer as trace_mod
+
+    def skewed():
+        for i in range(8):
+            rows = 64 if i % 4 == 0 else 4
+            yield np.ones((rows, 8), np.float32)
+
+    tracer = install(Tracer())
+    try:
+        it = scan_pipeline(skewed(), lanes=4, label="skew")
+        list(it)
+        assert it.stats.lane_bytes[0] == 2 * 64 * 8 * 4
+        assert it.stats.lane_bytes[1] == 2 * 4 * 8 * 4
+        assert it.stats.lane_chunks == [2, 2, 2, 2]
+        spans = [sp for sp in tracer.spans() if sp.name == SCAN_SPAN]
+        attrs = spans[-1].attrs
+        assert attrs["lane_bytes"] == it.stats.lane_bytes
+        assert attrs["lane_imbalance"] > 2.0  # max lane ≫ mean lane
+        lane_spans = [
+            sp for sp in tracer.spans() if sp.name == SCAN_LANE_SPAN
+        ]
+        assert len(lane_spans) == 4
+        assert {sp.attrs["lane"] for sp in lane_spans} == {0, 1, 2, 3}
+        for sp in lane_spans:
+            assert sp.parent_id == spans[-1].span_id
+            assert sp.attrs["device"]  # device attribution present
+    finally:
+        trace_mod.reset()
+
+
+def test_collectives_stamp_after_exhaustion_lands_on_span():
+    from keystone_tpu.obs import SCAN_SPAN, Tracer, install
+    from keystone_tpu.obs import tracer as trace_mod
+
+    devs = lane_devices(4)
+    tracer = install(Tracer())
+    try:
+        it = scan_pipeline(iter(_chunks(8)), lanes=4, label="t")
+        partials = [None] * 4
+        for i, c in enumerate(it):
+            lane = i % 4
+            s = jnp.sum(c, axis=0)
+            partials[lane] = s if partials[lane] is None else partials[lane] + s
+        # finalize-time reduction, AFTER the span was recorded
+        total = reduce_lane_partials(partials, scan=it)
+        assert total.devices() == {devs[0]}
+        spans = [sp for sp in tracer.spans() if sp.name == SCAN_SPAN]
+        assert spans[-1].attrs["collectives"] == 3  # 3 lanes hopped to lane 0
+        assert it.stats.collectives == 3
+    finally:
+        trace_mod.reset()
+
+
+# -- bucket-ladder lane rounding ---------------------------------------------
+
+
+def test_bucket_ladder_rounds_to_multiple():
+    assert bucket_ladder(20, multiple=4) == (4, 8, 12, 20)
+    assert bucket_ladder(512, multiple=8) == (64, 128, 256, 512)
+    # colliding rungs collapse
+    assert bucket_ladder(7, multiple=8) == (8,)
+    # multiple=1 is the historical ladder
+    assert bucket_ladder(20) == (3, 5, 10, 20)
+
+
+def test_chunk_padder_pads_ragged_tail_to_lane_multiple():
+    """Regression (ISSUE 7 satellite): a 7-row tail on a 4-device axis
+    must pad to 8, not 7 — otherwise the sharded fused program can't span
+    the mesh for the tail chunk."""
+    calls = []
+
+    def fn(x):
+        calls.append(int(x.shape[0]))
+        return np.asarray(x) + 1.0
+
+    padder = ChunkPadder(fn, multiple=4)
+    lead = np.zeros((16, 2), np.float32)
+    tail = np.arange(14, dtype=np.float32).reshape(7, 2)
+    np.testing.assert_allclose(np.asarray(padder(lead)), lead + 1.0)
+    out = padder(tail)
+    assert out.shape == (7, 2)
+    np.testing.assert_allclose(np.asarray(out), tail + 1.0)
+    assert 8 in calls, calls
+    assert all(c % 4 == 0 for c in calls), calls
+
+
+def test_chunk_padder_default_multiple_follows_mesh():
+    # on the suite's 8-device mesh every padded bucket divides by 8
+    calls = []
+
+    def fn(x):
+        calls.append(int(x.shape[0]))
+        return x
+
+    padder = ChunkPadder(fn)
+    padder(np.zeros((20, 2), np.float32))
+    padder(np.zeros((7, 2), np.float32))
+    assert all(c % 8 == 0 for c in calls), calls
+
+
+def test_chunk_padder_sharded_run_spans_mesh_and_is_exact():
+    from keystone_tpu.parallel.mesh import DATA_AXIS, default_mesh
+
+    seen = []
+
+    def fn(x):
+        seen.append(x.sharding)
+        return x * 2.0
+
+    padder = ChunkPadder(fn, shard=True)
+    x = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+    tail = x[:6]
+    np.testing.assert_allclose(np.asarray(padder(x)), x * 2.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(padder(tail)), tail * 2.0, rtol=1e-6)
+    for sh in seen:
+        # every (padded) chunk was committed row-sharded over the mesh
+        assert sh.spec[0] == DATA_AXIS, sh
+        assert len(sh.mesh.devices.flat) == len(default_mesh().devices.flat)
+
+
+def test_fused_chunked_chain_output_matches_under_sharding():
+    """End-to-end: the fused chain over a ragged chunked scan (now
+    mesh-sharded per chunk) still produces exact values."""
+    from keystone_tpu.data import ChunkedDataset
+    from keystone_tpu.workflow.transformer import FunctionNode
+
+    sizes = [64, 60, 25, 64, 7]
+    total = sum(sizes)
+    rng = np.random.default_rng(11)
+    parts = [rng.standard_normal((r, 5)).astype(np.float32) for r in sizes]
+    ds = ChunkedDataset.from_chunk_fn(lambda i: parts[i], len(sizes), total)
+    pipe = FunctionNode(batch_fn=lambda x: x * 2.0).and_then(
+        FunctionNode(batch_fn=lambda x: x + 1.0)
+    )
+    got = np.asarray(pipe.apply(ds).get().to_array())
+    np.testing.assert_allclose(
+        got, np.concatenate(parts) * 2.0 + 1.0, rtol=1e-6
+    )
+
+
+def test_chunk_padder_sharded_with_narrow_lane_knob(monkeypatch):
+    """Regression: KEYSTONE_SCAN_LANES narrower than the data axis makes
+    the ladder multiple 2 while batch_sharding spans all 8 devices — a
+    6-row bucket must fall back to the unsharded call, not crash XLA
+    with an indivisible dim."""
+    monkeypatch.setenv("KEYSTONE_SCAN_LANES", "2")
+    padder = ChunkPadder(lambda x: x * 2.0, shard=True)
+    x = np.random.default_rng(1).standard_normal((6, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(padder(x)), x * 2.0, rtol=1e-6)
+    tail = x[:5]  # pads to 6 (multiple of 2, not of 8) — unsharded path
+    np.testing.assert_allclose(np.asarray(padder(tail)), tail * 2.0, rtol=1e-6)
+
+
+def test_serial_staged_single_lane_unchanged():
+    chunks = _chunks(5)
+    out = list(serial_staged(iter(chunks), depth=2))
+    for got, want in zip(out, chunks):
+        np.testing.assert_array_equal(np.asarray(got), want)

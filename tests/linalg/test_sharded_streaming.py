@@ -1,0 +1,220 @@
+"""Mesh-sharded streaming-solver parity (ISSUE 7): per-lane partial
+accumulators reduced once per block / once at finalize must match the
+single-lane scan to <= 1e-6 on the suite's virtual 8-device mesh —
+including the intercept/centering path and a ragged final chunk — and the
+cross-mesh collective count must be O(blocks), never O(chunks)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import ChunkedDataset, Dataset
+from keystone_tpu.linalg import (
+    solve_blockwise_l2_streaming,
+    solve_least_squares_streaming,
+    stream_column_means,
+    tsqr_r,
+    tsqr_r_streaming,
+)
+
+TOL = 1e-6
+
+
+def _problem(n=208, d=24, k=3, seed=0, scale=None):
+    rng = np.random.default_rng(seed)
+    s = scale if scale is not None else 1.0 / np.sqrt(n)
+    A = (rng.standard_normal((n, d)) * s).astype(np.float32)
+    y = (rng.standard_normal((n, k)) * s).astype(np.float32)
+    return A, y
+
+
+def _maxdiff(a, b):
+    return float(np.abs(np.asarray(a) - np.asarray(b)).max())
+
+
+# -- normal equations ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("lanes", [2, 8])
+def test_streaming_normal_eq_lane_parity_with_ragged_tail(lanes):
+    A, y = _problem()
+    n = len(A)
+
+    def pairs():
+        # 7 chunks of 32 rows, then a ragged 16-row final chunk — more
+        # chunks than lanes, so the per-lane reduction genuinely reorders
+        return iter([(A[i : i + 32], y[i : i + 32]) for i in range(0, n, 32)])
+
+    W1 = solve_least_squares_streaming(pairs(), reg=0.1, lanes=1)
+    WN = solve_least_squares_streaming(pairs(), reg=0.1, lanes=lanes)
+    assert _maxdiff(W1, WN) <= TOL
+
+
+# -- BCD ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("lanes", [2, 8])
+@pytest.mark.parametrize("num_iter", [1, 2])
+def test_streaming_bcd_lane_parity_centered_ragged(lanes, num_iter):
+    A, y = _problem(n=204, d=16)
+    n = len(A)
+    means = jnp.asarray(A.mean(axis=0))
+
+    def scan():
+        # ragged final chunk (204 = 5*36 + 24)
+        return iter([A[i : i + 36] for i in range(0, n, 36)])
+
+    kw = dict(reg=0.1, block_size=4, num_iter=num_iter, means=means)
+    ws1 = solve_blockwise_l2_streaming(scan, jnp.asarray(y), lanes=1, **kw)
+    wsN = solve_blockwise_l2_streaming(scan, jnp.asarray(y), lanes=lanes, **kw)
+    for a, b in zip(ws1, wsN):
+        assert _maxdiff(a, b) <= TOL
+
+
+def test_streaming_bcd_tolerates_prestaged_passthrough_source():
+    """Regression: a chunk_scan that hands back an already-pipelined (or
+    otherwise pre-staged) iterator bypasses lane staging — the laned
+    solver must co-locate those chunks with its resident slabs instead of
+    mixing committed devices inside the lane program."""
+    from keystone_tpu.data.pipeline_scan import scan_pipeline
+
+    A, y = _problem(n=96, d=8)
+
+    def raw():
+        return iter([A[i : i + 24] for i in range(0, 96, 24)])
+
+    kw = dict(reg=0.1, block_size=4, num_iter=1,
+              means=jnp.asarray(A.mean(axis=0)))
+    ws_ref = solve_blockwise_l2_streaming(raw, jnp.asarray(y), lanes=1, **kw)
+    ws = solve_blockwise_l2_streaming(
+        lambda: scan_pipeline(raw(), label="pre"),  # lanes=1 passthrough
+        jnp.asarray(y), lanes=4, **kw,
+    )
+    for a, b in zip(ws_ref, ws):
+        assert _maxdiff(a, b) <= TOL
+
+
+def test_streaming_bcd_lane_boundary_change_rejected():
+    A, y = _problem(n=96, d=8)
+    boundaries = [[0, 48, 96], [0, 32, 64, 96]]
+
+    def scan():
+        cuts = boundaries.pop(0)
+        return iter([A[a:b] for a, b in zip(cuts, cuts[1:])])
+
+    with pytest.raises(ValueError, match="changed boundaries|produced"):
+        solve_blockwise_l2_streaming(
+            scan, jnp.asarray(y), reg=0.1, block_size=4, num_iter=1,
+            means=jnp.asarray(A.mean(axis=0)), lanes=4,
+        )
+
+
+# -- centering / intercept path ----------------------------------------------
+
+
+def test_block_estimator_streaming_intercept_lane_parity(monkeypatch):
+    """The full centering/intercept path (stream_column_means + centered
+    streaming BCD + label-mean intercept) through
+    BlockLeastSquaresEstimator: an 8-lane fit must match the 1-lane fit
+    to <= 1e-6 in weights, intercept, and predictions."""
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+
+    A, y = _problem(n=208, d=16, k=2, seed=3)
+    A = A + 0.5  # nonzero column means make centering do real work
+    labels = Dataset.of(jnp.asarray(y))
+
+    def fit(lanes):
+        monkeypatch.setenv("KEYSTONE_SCAN_LANES", str(lanes))
+        est = BlockLeastSquaresEstimator(block_size=4, num_iter=1, lam=0.1)
+        return est.fit(ChunkedDataset.from_array(A, 36), labels)
+
+    m1 = fit(1)
+    m8 = fit(8)
+    assert _maxdiff(m1._W, m8._W) <= TOL
+    assert _maxdiff(m1.b, m8.b) <= TOL
+    x = jnp.asarray(A[:16])
+    assert _maxdiff(m1.trace_batch(x), m8.trace_batch(x)) <= TOL
+
+
+def test_stream_column_means_lane_parity():
+    A, _ = _problem(n=208, d=24, scale=1.0)
+
+    def scan():
+        return iter([A[i : i + 32] for i in range(0, len(A), 32)])
+
+    mu1, n1 = stream_column_means(scan, lanes=1)
+    mu8, n8 = stream_column_means(scan, lanes=8)
+    assert n1 == n8 == len(A)
+    assert _maxdiff(mu1, mu8) <= TOL
+
+
+def test_standard_scaler_streaming_lane_parity(monkeypatch):
+    from keystone_tpu.nodes.stats import StandardScaler
+
+    rng = np.random.default_rng(17)
+    X = (rng.standard_normal((208, 6)) * 3.0 + 50.0).astype(np.float32)
+
+    def fit(lanes):
+        monkeypatch.setenv("KEYSTONE_SCAN_LANES", str(lanes))
+        return StandardScaler().fit(ChunkedDataset.from_array(X, 36))
+
+    m1, m8 = fit(1), fit(8)
+    assert _maxdiff(m1.mean, m8.mean) <= 1e-5
+    assert _maxdiff(m1.std, m8.std) <= 1e-5
+
+
+# -- collective schedule ------------------------------------------------------
+
+
+def _bcd_collectives(A, y, chunk, lanes, block_size=4):
+    from keystone_tpu.obs import SCAN_SPAN, Tracer, install
+    from keystone_tpu.obs import tracer as trace_mod
+
+    def scan():
+        return iter([A[i : i + chunk] for i in range(0, len(A), chunk)])
+
+    tracer = install(Tracer())
+    try:
+        solve_blockwise_l2_streaming(
+            scan, jnp.asarray(y), reg=0.1, block_size=block_size,
+            num_iter=1, means=jnp.asarray(A.mean(axis=0)), lanes=lanes,
+        )
+        spans = [
+            sp
+            for sp in tracer.spans()
+            if sp.name == SCAN_SPAN and sp.attrs["label"] == "bcd.stream"
+        ]
+        return [sp.attrs.get("collectives", 0) for sp in spans]
+    finally:
+        trace_mod.reset()
+
+
+def test_bcd_collectives_per_block_not_per_chunk():
+    """The PAPERS.md #3 gate: per-scan cross-mesh transfers must not grow
+    with the chunk count — halving the chunk size (2x the chunks) leaves
+    every block step's collective count unchanged."""
+    A, y = _problem(n=192, d=16)
+    coarse = _bcd_collectives(A, y, chunk=48, lanes=4)  # 4 chunks/scan
+    fine = _bcd_collectives(A, y, chunk=24, lanes=4)    # 8 chunks/scan
+    assert len(coarse) == len(fine) == 4  # one scan per block step
+    assert coarse == fine
+    assert all(c > 0 for c in coarse)
+    # and the single-lane path reports no cross-mesh traffic at all
+    single = _bcd_collectives(A, y, chunk=48, lanes=1)
+    assert all(c == 0 for c in single)
+
+
+# -- TSQR ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("lanes", [1, 8])
+def test_tsqr_streaming_matches_mesh_tsqr(lanes):
+    A, _ = _problem(n=192, d=8, scale=1.0)
+
+    def scan():
+        return iter([A[i : i + 36] for i in range(0, len(A), 36)])
+
+    R_mesh = tsqr_r(jnp.asarray(A))
+    R_stream = tsqr_r_streaming(scan, lanes=lanes)
+    assert R_stream.shape == (8, 8)
+    assert _maxdiff(R_mesh, R_stream) <= 5e-5  # f32 QR, different orders
