@@ -2380,6 +2380,142 @@ print(json.dumps({
     }
 
 
+def bench_cost_model() -> dict:
+    """Cost-model subsystem probe, two parts.
+
+    (1) Chooser-vs-measurement on two probe shapes: every viable solver is
+    timed fitting real data at a tall-skinny and a wide shape; the cold
+    (analytic) pick and the learned pick (after the measured throughput is
+    folded into a throwaway profile store, exactly what a traced run
+    feeds back) are both recorded against the measured-fastest solver.
+    The learned chooser must agree on BOTH shapes — that agreement is the
+    subsystem's contract; the cold chooser's wide-shape miss is the
+    measured headroom evidence recovers.
+
+    (2) The zero-sampling re-plan loop: the same pipeline is fit twice
+    against a throwaway profile dir; run 1 pays sampled profiling, run 2
+    must plan solver + caching entirely from the persisted profiles
+    (zero sampling executions) and reproduce the model bit-for-bit at
+    fp32 tolerance.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import keystone_tpu.cost as cost
+    from keystone_tpu.cost import CostEstimator, ProfileStore, ShapeSignature
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import LeastSquaresEstimator
+    from keystone_tpu.workflow.env import PipelineEnv
+    from keystone_tpu.workflow.optimizers import AutoCachingOptimizer
+
+    rng = np.random.default_rng(0)
+    out = {"shapes": [], "replan": None}
+
+    # -- part 1: pick vs measured-fastest --------------------------------
+    probe_dir = tempfile.mkdtemp(prefix="keystone-bench-profiles-")
+    try:
+        for name, (n, d, k) in (
+            ("tall_skinny", (16384, 64, 8)),
+            ("wide", (512, 4096, 4)),
+        ):
+            # a fresh store per shape: the spu EWMA is per CLASS, so
+            # shape-1 evidence folded into shape-2's pricing would let a
+            # near-tie at one shape flip the other's learned pick
+            store = ProfileStore(os.path.join(probe_dir, name))
+            estimator = CostEstimator(store)
+            X = rng.standard_normal((n, d)).astype(np.float32)
+            Y = rng.standard_normal((n, k)).astype(np.float32)
+            auto = LeastSquaresEstimator(lam=1e-2)
+            shape = ShapeSignature(n=n, d=d, k=k, machines=1)
+            cold = auto.choose_solver(shape).label
+            times = {}
+            for opt in auto.options:
+                cls = type(opt).__name__
+                if cls == "SparseLBFGSwithL2":
+                    continue  # dense probes; it would only densify
+                reps = []
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    model = opt.fit(Dataset.of(X), Dataset.of(Y))
+                    _fetch_scalar(model.W if hasattr(model, "W") else model._W)
+                    reps.append(time.perf_counter() - t0)
+                times[cls] = round(min(reps), 4)
+                # the feedback a traced run would produce: seconds per
+                # analytic unit for this class at this shape
+                units = opt.cost(
+                    n, d, k, 1.0, 1, auto.cpu_weight, auto.mem_weight,
+                    auto.network_weight,
+                )
+                estimator.observe_solver(cls, units, min(reps))
+            fastest = min(times, key=times.get)
+            learned = (
+                type(
+                    cost.SolverChooser(estimator).choose(
+                        auto.options, shape, auto.cpu_weight,
+                        auto.mem_weight, auto.network_weight,
+                    ).chosen
+                ).__name__
+            )
+            out["shapes"].append(
+                {
+                    "shape": {"n": n, "d": d, "k": k},
+                    "name": name,
+                    "fit_seconds": times,
+                    "measured_fastest": fastest,
+                    "cold_pick": cold,
+                    "cold_agrees": cold == fastest,
+                    "learned_pick": learned,
+                    "learned_agrees": learned == fastest,
+                }
+            )
+        assert all(s["learned_agrees"] for s in out["shapes"]), out["shapes"]
+    finally:
+        shutil.rmtree(probe_dir, ignore_errors=True)
+
+    # -- part 2: the zero-sampling second fit ----------------------------
+    replan_dir = tempfile.mkdtemp(prefix="keystone-bench-replan-")
+    env = PipelineEnv.get_or_create()
+    prior_optimizer = env._optimizer
+    try:
+        env.set_optimizer(AutoCachingOptimizer())
+        cost.configure(replan_dir)
+        X = rng.standard_normal((2048, 64)).astype(np.float32)
+        Y = rng.standard_normal((2048, 8)).astype(np.float32)
+
+        def fit_once():
+            cost.reset_sampling()
+            auto = LeastSquaresEstimator(lam=1e-2)
+            t0 = time.perf_counter()
+            fitted = auto.with_data(Dataset.of(X), Dataset.of(Y)).fit()
+            seconds = time.perf_counter() - t0
+            pred = np.asarray(
+                Dataset.of(fitted.apply(Dataset.of(X[:32]))).to_array()
+            )
+            return pred, cost.sampling_executions()["total"], seconds
+
+        pred1, sampled1, secs1 = fit_once()
+        pred2, sampled2, secs2 = fit_once()
+        delta = float(np.abs(pred1 - pred2).max())
+        assert sampled2 == 0, f"second fit sampled {sampled2} executions"
+        assert delta <= 1e-6, f"second fit model drifted {delta}"
+        out["replan"] = {
+            "run1_sampling_executions": sampled1,
+            "run2_sampling_executions": sampled2,
+            "run1_fit_seconds": round(secs1, 4),
+            "run2_fit_seconds": round(secs2, 4),
+            "model_max_abs_delta": delta,
+            "store_keys": cost.get_store().keys(),
+        }
+    finally:
+        cost.configure("")
+        env.set_optimizer(prior_optimizer) if prior_optimizer is not None \
+            else env.reset()
+        shutil.rmtree(replan_dir, ignore_errors=True)
+    return out
+
+
 def _section(name, fn):
     """Run one bench section with stderr progress (stdout stays pure JSON)."""
     import sys
@@ -2411,6 +2547,7 @@ def main() -> int:
     chunk_pipeline = _section("chunk_pipeline", bench_chunk_pipeline)
     gather_parallel = _section("gather_parallel", bench_gather_parallel)
     serve_cold_start = _section("serve_cold_start", bench_serve_cold_start)
+    cost_model = _section("cost_model", bench_cost_model)
     weak_scaling = _section("weak_scaling", bench_weak_scaling)
     sharded_scan = _section("sharded_scan", bench_sharded_scan)
     from keystone_tpu.obs import tracer as trace_mod
@@ -2453,6 +2590,7 @@ def main() -> int:
                     "chunk_pipeline": chunk_pipeline,
                     "gather_parallel": gather_parallel,
                     "serve_cold_start": serve_cold_start,
+                    "cost_model": cost_model,
                     "weak_scaling_virtual_mesh": weak_scaling,
                     "sharded_scan": sharded_scan,
                     "trace": trace_extra,

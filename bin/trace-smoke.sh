@@ -17,6 +17,11 @@
 # A fifth stage runs a mesh-sharded streaming fit on a 4-device virtual
 # mesh and asserts the sharded scan emits per-lane spans with device
 # attribution and a per-scan `collectives` attr on the scan span.
+# A sixth stage fits a pipeline twice against a fresh profile store under
+# tracing and asserts the cost-model spans: `cost.estimate` (solver choice
+# + cache-plan pricing) and `cost.replan` (trace-informed re-plan) on the
+# cold run, and an evidence-planned (`source: profiles`) cost.estimate on
+# the warm run.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
@@ -221,3 +226,63 @@ assert len(devices) == 4, devices  # per-lane device attribution
 print(f"SHARDED SCAN SPANS OK: {len(scans)} scan span(s), "
       f"{len(lanes)} lane span(s) over {len(devices)} devices -> {path}")
 PY
+
+# -- cost-model spans ---------------------------------------------------------
+prof_dir="$(mktemp -d /tmp/keystone-prof-trace-XXXXXX)"
+trap 'rm -rf "$aot_dir" "$prof_dir"' EXIT
+for mode in cold warm; do
+  cost_out="$(mktemp /tmp/keystone-cost-trace-XXXXXX.json)"
+  env JAX_PLATFORMS=cpu KEYSTONE_TRACE="$cost_out" \
+    KEYSTONE_PROFILE_DIR="$prof_dir" python - "$cost_out" "$mode" <<'PY'
+import json
+import sys
+
+import numpy as np
+
+from keystone_tpu.utils.obs import configure, export_trace
+
+configure()
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LeastSquaresEstimator
+from keystone_tpu.workflow.env import PipelineEnv
+from keystone_tpu.workflow.optimizers import AutoCachingOptimizer
+
+PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+
+import keystone_tpu.cost as cost
+
+cost.reset_sampling()
+rng = np.random.default_rng(0)
+X = rng.standard_normal((1024, 32)).astype(np.float32)
+Y = rng.standard_normal((1024, 4)).astype(np.float32)
+LeastSquaresEstimator(lam=1e-2).with_data(Dataset.of(X), Dataset.of(Y)).fit()
+sampled = cost.sampling_executions()["total"]
+path = export_trace()
+assert path == sys.argv[1], (path, sys.argv[1])
+with open(path) as f:
+    doc = json.load(f)
+mode = sys.argv[2]
+est = [e for e in doc["traceEvents"] if e["name"] == "cost.estimate"]
+rep = [e for e in doc["traceEvents"] if e["name"] == "cost.replan"]
+assert est, "no cost.estimate spans"
+assert rep, "no cost.replan spans"
+solver_spans = [e for e in est if e["args"].get("solver")]
+assert solver_spans, "no solver-choice cost.estimate span"
+cache_spans = [e for e in est if e["args"].get("op_type") == "AutoCacheRule"]
+assert cache_spans, "no cache-plan cost.estimate span"
+if mode == "cold":
+    assert sampled > 0, "cold run should pay sampling"
+    assert any(
+        str(e["args"].get("source", "")).startswith("sampled")
+        for e in cache_spans
+    ), cache_spans
+else:
+    assert sampled == 0, f"warm run sampled {sampled} executions"
+    assert any(
+        e["args"].get("source") == "profiles" for e in cache_spans
+    ), cache_spans
+print(f"COST SPANS OK ({mode}): {len(est)} cost.estimate, "
+      f"{len(rep)} cost.replan, sampling={sampled}")
+PY
+done

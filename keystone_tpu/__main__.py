@@ -194,6 +194,14 @@ def main(argv: Optional[List[str]] = None) -> int:
              "re-tracing, so warm boots skip every compile "
              "(also: KEYSTONE_AOT_CACHE=DIR)",
     )
+    p.add_argument(
+        "--profiles", default=None, metavar="DIR", dest="profiles",
+        help="persistent operator-profile store directory: fits learn "
+             "per-operator throughput from traced runs, and the second "
+             "fit of a pipeline plans solver choice + caching from the "
+             "stored evidence with zero sampling executions "
+             "(also: KEYSTONE_PROFILE_DIR=DIR)",
+    )
     args, rest = p.parse_known_args(argv)
     if not serve_demo:
         name = _resolve_pipeline(p, args.pipeline)
@@ -201,7 +209,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     configure(
         args.log_level, profile=args.profile or None, trace=args.trace,
-        aot_cache=args.aot_cache,
+        aot_cache=args.aot_cache, profiles=args.profiles,
     )
     _select_backend(args.backend, args.cpuDevices)
     try:

@@ -1,0 +1,156 @@
+"""Cost-model subsystem: learned operator profiles, per-node solver
+selection, trace-informed re-planning.
+
+KeystoneML's core contribution (PAPERS.md #1) is not the DAG executor —
+it is the cost model that picks each node's physical implementation and
+the caching plan from operator profiles. This package is that loop,
+closed: the optimizer's decisions are priced by
+:class:`~keystone_tpu.cost.model.CostEstimator`, chosen by
+:class:`~keystone_tpu.cost.chooser.SolverChooser`, observed by the tracer
+(``obs/``), and fed back through
+:mod:`~keystone_tpu.cost.replan` into a persistent
+:class:`~keystone_tpu.cost.store.ProfileStore` — so the second fit of any
+pipeline is planned from evidence, not samples.
+
+Store layout (one JSON record per file, atomic + checksummed +
+backend/device-kind isolated; see ``cost/store.py``):
+
+* ``op/<OperatorClass>`` — class-level throughput: ``spu`` (EWMA seconds
+  per analytic cost unit, solvers), ``seconds_per_item`` /
+  ``bytes_per_item`` (EWMA node throughput), observation counts.
+* ``solver/<graph-fp>`` — the auto-solver node's observed shape
+  signature + chosen implementation for one pipeline.
+* ``plan/<graph-fp>`` — per-node observed seconds/bytes (+ the measured
+  estimate-vs-observed ``ratio``) for one pipeline: the evidence the
+  cache planner plans from with zero sampling executions.
+
+Knobs: ``KEYSTONE_PROFILE_DIR=<dir>`` (or ``--profiles`` on the CLI, or
+``utils.obs.configure(profiles=...)``) enables the store. Without it the
+subsystem stays cold: choices fall back to the analytic cost model and
+nothing touches disk.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+from .chooser import SolverChoice, SolverChooser
+from .model import CostEstimator, ShapeSignature, op_key
+from .replan import (
+    PendingPlan,
+    current_plan,
+    finalize,
+    graph_fingerprint,
+    pending_plan,
+)
+from .store import ProfileStore, profile_environment
+
+__all__ = [
+    "CostEstimator",
+    "PendingPlan",
+    "ProfileStore",
+    "ShapeSignature",
+    "SolverChoice",
+    "SolverChooser",
+    "configure",
+    "current_plan",
+    "finalize",
+    "get_estimator",
+    "get_store",
+    "graph_fingerprint",
+    "op_key",
+    "pending_plan",
+    "profile_environment",
+    "reset",
+    "sampling_executions",
+    "count_sampling",
+    "reset_sampling",
+]
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_store: Optional[ProfileStore] = None
+_initialized = False  # False => next get_store() reads KEYSTONE_PROFILE_DIR
+
+
+def configure(path: Optional[str] = None) -> Optional[ProfileStore]:
+    """Install the process-wide profile store. ``path=None`` follows
+    ``KEYSTONE_PROFILE_DIR`` (unset or empty ⇒ profile learning off).
+    An unusable directory degrades to off, never a crash."""
+    global _store, _initialized
+    with _lock:
+        _initialized = True
+        if path is None:
+            path = os.environ.get("KEYSTONE_PROFILE_DIR") or None
+        if not path:
+            _store = None
+            return None
+        try:
+            _store = ProfileStore(path)
+        except Exception:
+            logger.warning(
+                "cost: profile dir %r unusable — profile learning disabled",
+                path, exc_info=True,
+            )
+            _store = None
+            return None
+        return _store
+
+
+def get_store() -> Optional[ProfileStore]:
+    """The installed store, or None (cold). Lazily honors
+    ``KEYSTONE_PROFILE_DIR`` like ``compile.get_cache``."""
+    if not _initialized:
+        return configure()
+    return _store
+
+
+def get_estimator() -> CostEstimator:
+    """A CostEstimator over the installed store (store-less when cold)."""
+    return CostEstimator(get_store())
+
+
+def reset() -> None:
+    """Forget the installed store AND the env memo (test hygiene)."""
+    global _store, _initialized
+    with _lock:
+        _store = None
+        _initialized = False
+    reset_sampling()
+
+
+# ---------------------------------------------------------------------------
+# Sampling-execution accounting: how many sampled-scale executions the
+# planner paid for this process (zero on an evidence-planned fit)
+# ---------------------------------------------------------------------------
+
+_sampling_lock = threading.Lock()
+_sampling: Dict[str, int] = {}
+
+
+def count_sampling(kind: str, n: int = 1) -> None:
+    """Record ``n`` sampled-scale executions of ``kind`` (e.g.
+    ``node_optimization``, ``autocache``)."""
+    with _sampling_lock:
+        _sampling[kind] = _sampling.get(kind, 0) + n
+    plan = current_plan()
+    if plan is not None:
+        plan.sampling_executions += n
+
+
+def sampling_executions() -> Dict[str, int]:
+    """Per-kind counts of sampled-scale executions since the last reset
+    (plus a ``"total"`` roll-up)."""
+    with _sampling_lock:
+        out = dict(_sampling)
+    out["total"] = sum(out.values())
+    return out
+
+
+def reset_sampling() -> None:
+    with _sampling_lock:
+        _sampling.clear()

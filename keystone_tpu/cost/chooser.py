@@ -1,0 +1,145 @@
+"""Per-node solver selection from predicted cost.
+
+The chooser is the decision point between the cost model and the graph:
+given a candidate option set (the auto-solver's physical implementations)
+and a :class:`~keystone_tpu.cost.model.ShapeSignature`, it prices every
+option through :class:`~keystone_tpu.cost.model.CostEstimator` and picks
+the cheapest — analytic units when cold, predicted wall-clock seconds
+once the profile store holds evidence. Chunked (out-of-core) inputs
+restrict the field to options with a streaming fit path.
+
+Every choice is observable: a ``cost.estimate`` span records the shape,
+the winner, and whether evidence participated; when a DAG node id is
+known the prediction is also recorded as a tracer *estimate* row, so the
+estimate-vs-observed audit (``obs/audit.py``) covers solver nodes exactly
+like Cacher-annotated ones.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+from ..obs import tracer as obs_tracer
+from .model import CostEstimator, ShapeSignature
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SolverChoice:
+    """One selection: the winning option plus the full pricing table."""
+
+    chosen: object
+    label: str
+    shape: ShapeSignature
+    #: per-option {"units", "spu", "seconds", "learned"} (see
+    #: CostEstimator.solver_costs)
+    costs: Dict[str, Dict[str, Optional[float]]] = field(default_factory=dict)
+    #: "learned" when stored evidence priced at least one option,
+    #: else "cold" (analytic units only)
+    source: str = "cold"
+
+    @property
+    def est_seconds(self) -> Optional[float]:
+        return self.costs.get(self.label, {}).get("seconds")
+
+
+class SolverChooser:
+    """Ranks solver options by predicted cost; see module docstring."""
+
+    def __init__(self, estimator: Optional[CostEstimator] = None):
+        if estimator is None:
+            from . import get_estimator
+
+            estimator = get_estimator()
+        self.estimator = estimator
+
+    def choose(
+        self,
+        options: Sequence,
+        shape: ShapeSignature,
+        cpu_weight: float,
+        mem_weight: float,
+        network_weight: float,
+        node_id: Optional[str] = None,
+        owner_label: str = "solver",
+    ) -> SolverChoice:
+        if not options:
+            raise ValueError("no solver options to choose from")
+        costs = self.estimator.solver_costs(
+            options, shape, cpu_weight, mem_weight, network_weight
+        )
+
+        def rank(opt) -> float:
+            row = costs[type(opt).__name__]
+            if row["seconds"] is not None:
+                return row["seconds"]
+            u = row["units"]
+            return u if math.isfinite(u) else math.inf
+
+        viable = [o for o in options if math.isfinite(rank(o))]
+        if not viable:
+            # every option priced out (e.g. chunked input, no streaming
+            # solver registered) — keep the first option rather than fail;
+            # its fit will raise a real error if it truly cannot run
+            logger.warning(
+                "%s: no viable solver for %s — keeping %s",
+                owner_label, shape, type(options[0]).__name__,
+            )
+            viable = [options[0]]
+        chosen = min(viable, key=rank)
+        label = type(chosen).__name__
+        learned = any(row["learned"] for row in costs.values())
+        choice = SolverChoice(
+            chosen=chosen,
+            label=label,
+            shape=shape,
+            costs=costs,
+            source="learned" if learned else "cold",
+        )
+        self._record(choice, node_id, owner_label)
+        return choice
+
+    @staticmethod
+    def _record(
+        choice: SolverChoice, node_id: Optional[str], owner_label: str
+    ) -> None:
+        tracer = obs_tracer.current()
+        if tracer is None:
+            return
+        with tracer.span(
+            "cost.estimate",
+            node_id=node_id,
+            op_type=owner_label,
+            solver=choice.label,
+            source=choice.source,
+            n=choice.shape.n,
+            d=choice.shape.d,
+            k=choice.shape.k,
+            chunked=choice.shape.chunked,
+        ):
+            pass
+        if node_id is not None:
+            est = choice.est_seconds
+            tracer.record_node_estimate(
+                node_id,
+                choice.label,
+                est_seconds=None if est is None else float(est),
+                # the fitted model is the node's materialized result
+                est_bytes=float(choice.shape.d * choice.shape.k * 4),
+                cacher=False,
+                kind="solver",
+                solver=choice.label,
+                # survives a later overwrite of est_seconds by the cache
+                # planner's node-level extrapolation (extras are preserved)
+                solver_est_seconds=None if est is None else float(est),
+                source=choice.source,
+                alternatives={
+                    lbl: row["seconds"] if row["seconds"] is not None
+                    else (row["units"] if math.isfinite(row["units"]) else None)
+                    for lbl, row in choice.costs.items()
+                },
+            )

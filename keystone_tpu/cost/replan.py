@@ -1,0 +1,396 @@
+"""Trace-informed re-planning: the loop that turns plans into evidence.
+
+``Pipeline.fit`` opens a :class:`PendingPlan` around the optimizer run;
+the planning rules contribute what they decided (solver choice + shape,
+per-node cost estimates, the cache plan and its budget). After the fit
+executes, :func:`finalize` joins those decisions against the trace's
+observed per-node costs (``obs/audit.py``) and
+
+1. updates the profile store's ``op/<OperatorClass>`` throughput records
+   (solver seconds-per-unit, per-item node seconds/bytes),
+2. persists ``solver/<fp>`` and ``plan/<fp>`` records so the NEXT fit of
+   the same pipeline plans from evidence with zero sampling executions,
+3. re-derives the greedy cache plan from OBSERVED node costs and logs the
+   delta against the sampled plan (a ``cost.replan`` span carries the
+   added/removed node labels) — the KeystoneML loop closed: the planner
+   is no longer blind to how its estimates held up.
+
+Graph identity is a structural fingerprint (:func:`graph_fingerprint`):
+operator class + label + topology over the deterministic linearization.
+Node ids are never persisted — records address nodes by topological
+index, which is stable across processes for the same pipeline build.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+#: plan-record schema version — bump to invalidate persisted plans
+PLAN_VERSION = 1
+
+
+def _leaf_signature(op) -> str:
+    """A cheap data-shape signature for a DatasetOperator leaf. The
+    operator's label only encodes n — but a stored solver shape replayed
+    against a dataset with a different per-item width would be stale
+    evidence, so the fingerprint must see d too. Batched array payloads
+    expose .shape directly; chunked sources contribute their row count
+    and label; item-list datasets fall back to n alone (their per-item
+    shape is not knowable without compute)."""
+    from ..data.chunked import ChunkedDataset
+    from ..workflow.operators import DatasetOperator
+
+    if not isinstance(op, DatasetOperator):
+        return ""
+    ds = op.dataset
+    if isinstance(ds, ChunkedDataset):
+        return f"|chunked[{len(ds)}]"
+    shape = getattr(ds.payload, "shape", None)
+    if shape is not None:
+        return f"|shape{tuple(int(s) for s in shape)}"
+    return f"|items[{len(ds)}]"
+
+
+def graph_fingerprint(graph) -> str:
+    """Process-stable sha256 of a workflow graph's structure: one line per
+    linearized id (kind, operator class, label + leaf data shape,
+    dependency indices)."""
+    from ..workflow import analysis
+    from ..workflow.graph import NodeId, SinkId, SourceId
+
+    order = analysis.linearize(graph)
+    index = {gid: i for i, gid in enumerate(order)}
+    h = hashlib.sha256()
+    for gid in order:
+        if isinstance(gid, NodeId):
+            op = graph.get_operator(gid)
+            deps = ",".join(
+                str(index[d]) for d in graph.get_dependencies(gid)
+            )
+            line = (
+                f"N|{type(op).__module__}.{type(op).__qualname__}"
+                f"|{op.label}{_leaf_signature(op)}|{deps}"
+            )
+        elif isinstance(gid, SourceId):
+            line = "S"
+        elif isinstance(gid, SinkId):
+            line = f"K|{index[graph.get_sink_dependency(gid)]}"
+        else:  # pragma: no cover - no other id kinds exist
+            line = f"?|{gid!r}"
+        h.update(line.encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def topo_node_index(graph) -> Dict[object, int]:
+    """NodeId -> linearized index (the persistent node address)."""
+    from ..workflow import analysis
+    from ..workflow.graph import NodeId
+
+    return {
+        gid: i
+        for i, gid in enumerate(analysis.linearize(graph))
+        if isinstance(gid, NodeId)
+    }
+
+
+# ---------------------------------------------------------------------------
+# The pending plan: rules deposit decisions here during one fit
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PendingPlan:
+    store: object  # ProfileStore
+    #: solver decision: {"fp", "node_idx", "node_id", "shape", "chosen",
+    #:  "units", "sampled"}
+    solver: Optional[Dict] = None
+    #: cache plan: {"fp", "graph", "budget", "strategy", "selected",
+    #:  "source", "nodes": {node_id_str: {...}}}
+    autocache: Optional[Dict] = None
+    #: sampling executions performed while planning this fit
+    sampling_executions: int = 0
+    #: tracer span count when this fit opened — finalize joins only
+    #: against spans recorded after it, so a long-lived KEYSTONE_TRACE
+    #: tracer doesn't leak earlier fits' observations (same small-int
+    #: NodeIds) into this fit's evidence
+    span_watermark: int = 0
+
+
+_local = threading.local()
+
+
+def current_plan() -> Optional[PendingPlan]:
+    return getattr(_local, "plan", None)
+
+
+@contextlib.contextmanager
+def pending_plan(store):
+    """Arm a PendingPlan for the calling thread's fit (no-op without a
+    store). Yields the plan (or None)."""
+    if store is None or current_plan() is not None:
+        yield None
+        return
+    plan = PendingPlan(store=store)
+    _local.plan = plan
+    try:
+        yield plan
+    finally:
+        _local.plan = None
+
+
+# ---------------------------------------------------------------------------
+# Finalize: join plan vs observation, update the store, re-plan
+# ---------------------------------------------------------------------------
+
+
+def finalize(plan: Optional[PendingPlan], tracer) -> None:
+    """Close the loop after a fit. Never raises — a failed profile update
+    must not fail a fit that already produced a model."""
+    if plan is None or plan.store is None or tracer is None:
+        return
+    try:
+        _finalize(plan, tracer)
+    except Exception:
+        logger.warning("cost: trace-informed re-plan failed", exc_info=True)
+
+
+def _finalize(plan: PendingPlan, tracer) -> None:
+    from ..obs.audit import observed_by_node
+    from .model import CostEstimator
+
+    observed = observed_by_node(tracer, start=plan.span_watermark)
+    estimator = CostEstimator(plan.store)
+
+    # -- solver evidence -------------------------------------------------
+    if plan.solver is not None:
+        sol = plan.solver
+        obs = observed.get(str(sol["node_id"]))
+        if obs is not None and obs["seconds"] > 0:
+            estimator.observe_solver(
+                sol["chosen"], float(sol["units"]), obs["seconds"]
+            )
+        plan.store.update(
+            f"solver/{sol['fp']}",
+            lambda rec: {
+                "version": PLAN_VERSION,
+                "node_idx": int(sol["node_idx"]),
+                "shape": sol["shape"],
+                "chosen": sol["chosen"],
+                "observed_seconds": (
+                    None if obs is None else round(obs["seconds"], 6)
+                ),
+            },
+        )
+
+    # -- per-node evidence + cache re-plan -------------------------------
+    if plan.autocache is None:
+        return
+    ac = plan.autocache
+    graph = ac["graph"]
+    index = topo_node_index(graph)
+    node_at = {i: n for n, i in index.items()}
+    nodes_rec: Dict[str, Dict] = {}
+    replan_input = {}
+    class_obs: Dict[str, List] = {}
+    n_full = max(int(ac.get("full_n", 1)), 1)
+    for node_id_str, meta in ac["nodes"].items():
+        obs = observed.get(node_id_str)
+        est_ns = meta.get("est_ns")
+        row = {
+            "idx": meta["idx"],
+            "label": meta["label"],
+            "op_class": meta["op_class"],
+            "n": n_full,
+            "observed": obs is not None,
+        }
+        if obs is not None:
+            row["seconds"] = round(obs["seconds"], 6)
+            row["bytes"] = obs["bytes"] if obs["bytes"] is not None else (
+                meta.get("est_bytes") or 0.0
+            )
+            if est_ns:
+                # the measured sample-to-full ratio for THIS node — the
+                # per-node correction the next sampled extrapolation applies
+                row["ratio"] = round(obs["seconds"] * 1e9 / est_ns, 6)
+            if not meta.get("leaf"):
+                # fold per class AFTER the loop: one store round-trip per
+                # operator class, not one per node
+                class_obs.setdefault(meta["op_class"], []).append(
+                    (n_full, obs["seconds"], obs["bytes"])
+                )
+        else:
+            # fused away or never pulled: carry the estimate forward so
+            # the next run's evidence plan still covers the node. A node
+            # with NEITHER estimate nor observation stores 0.0 seconds —
+            # deliberately equivalent to the sampled path, where a node
+            # absent from the profiles is likewise never a cache
+            # candidate (zero save == never selected by the greedy).
+            row["seconds"] = (est_ns or 0.0) / 1e9
+            row["bytes"] = meta.get("est_bytes") or 0.0
+        nodes_rec[str(meta["idx"])] = row
+        node = node_at.get(meta["idx"])
+        if node is not None:
+            from ..workflow.autocache import Profile
+
+            replan_input[node] = Profile(
+                float(row["seconds"]) * 1e9, float(row["bytes"] or 0.0)
+            )
+
+    for op_class, observations in class_obs.items():
+        estimator.observe_nodes(op_class, observations)
+
+    plan.store.update(
+        f"plan/{ac['fp']}",
+        lambda rec: {
+            "version": PLAN_VERSION,
+            "strategy": ac["strategy"],
+            "budget": ac["budget"],
+            "full_n": n_full,
+            "source": ac["source"],
+            "nodes": nodes_rec,
+        },
+    )
+
+    _replan_cache(plan, tracer, graph, replan_input)
+
+
+def _replan_cache(plan: PendingPlan, tracer, graph, profiles) -> None:
+    """Re-run the greedy cache selection on observed costs and log the
+    delta vs the plan that actually executed."""
+    from ..workflow.autocache import AutoCacheRule
+
+    ac = plan.autocache
+    planned: Set = set(ac["selected"])
+    if ac["strategy"] != "greedy" or not profiles:
+        return
+    rule = AutoCacheRule("greedy", ac["budget"])
+    evidence = rule._select_greedy(graph, profiles, float(ac["budget"]))
+    added = sorted(
+        graph.get_operator(n).label for n in evidence - planned
+    )
+    removed = sorted(
+        graph.get_operator(n).label for n in planned - evidence
+    )
+    changed = bool(added or removed)
+    with tracer.span(
+        "cost.replan",
+        op_type="AutoCacheRule",
+        plan_changed=changed,
+        added=",".join(added),
+        removed=",".join(removed),
+        nodes=len(profiles),
+    ):
+        pass
+    if changed:
+        logger.info(
+            "cost re-plan: observed costs change the cache plan "
+            "(+%s / -%s) — next fit of this pipeline uses the evidence plan",
+            added or "none", removed or "none",
+        )
+    else:
+        logger.info(
+            "cost re-plan: observed costs confirm the cache plan "
+            "(%d nodes priced)", len(profiles),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Planning-side reads: evidence in, sampling out
+# ---------------------------------------------------------------------------
+
+
+def stored_solver_shape(store, fp: str, node_idx: int):
+    """The shape signature observed for this pipeline's solver node in a
+    previous run, or None."""
+    from .model import ShapeSignature
+
+    if store is None:
+        return None
+    rec = store.load(f"solver/{fp}")
+    if not rec or rec.get("version") != PLAN_VERSION:
+        return None
+    if int(rec.get("node_idx", -1)) != int(node_idx):
+        return None
+    return ShapeSignature.from_record(rec.get("shape") or {})
+
+
+#: sentinel: "caller did not preload the plan record" (None is a real miss)
+_UNLOADED = object()
+
+
+def load_plan_record(store, fp: str):
+    """The raw ``plan/<fp>`` record (or None) — load once and hand to both
+    :func:`stored_profiles` and :func:`stored_calibration` via ``rec=``."""
+    return store.load(f"plan/{fp}") if store is not None else None
+
+
+def stored_profiles(
+    store, graph, full_n: int,
+    fp: Optional[str] = None, index: Optional[Dict] = None, rec=_UNLOADED,
+):
+    """Per-node Profile dict for this graph from a persisted plan record,
+    or None unless EVERY current node is covered (label-checked). Seconds
+    scale by the current/recorded item-count ratio. ``fp``/``index``/``rec``
+    skip re-fingerprinting/re-linearizing/re-loading when the caller
+    already has them."""
+    from ..workflow.autocache import Profile
+
+    if store is None:
+        return None
+    fp = fp or graph_fingerprint(graph)
+    if rec is _UNLOADED:
+        rec = store.load(f"plan/{fp}")
+    if not rec or rec.get("version") != PLAN_VERSION:
+        return None
+    nodes = rec.get("nodes") or {}
+    if index is None:
+        index = topo_node_index(graph)
+    out = {}
+    for node, idx in index.items():
+        row = nodes.get(str(idx))
+        if row is None:
+            return None  # partial evidence — fall back to sampling
+        if row.get("label") != graph.get_operator(node).label:
+            return None  # structure drifted despite fp match (paranoia)
+        n_rec = max(int(row.get("n", 1)), 1)
+        scale = float(max(full_n, 1)) / n_rec
+        out[node] = Profile(
+            float(row.get("seconds", 0.0)) * 1e9 * scale,
+            float(row.get("bytes", 0.0) or 0.0) * scale,
+        )
+    return out
+
+
+def stored_calibration(
+    store, graph, fp: Optional[str] = None, index: Optional[Dict] = None,
+    rec=_UNLOADED,
+) -> Dict[object, float]:
+    """Per-node observed/estimated seconds ratios from the last traced
+    run of this pipeline — the measured sample-to-full correction applied
+    to a fresh sampled extrapolation (empty dict without evidence)."""
+    if store is None:
+        return {}
+    if rec is _UNLOADED:
+        rec = store.load(f"plan/{fp or graph_fingerprint(graph)}")
+    if not rec or rec.get("version") != PLAN_VERSION:
+        return {}
+    nodes = rec.get("nodes") or {}
+    out = {}
+    if index is None:
+        index = topo_node_index(graph)
+    for node, idx in index.items():
+        row = nodes.get(str(idx))
+        if not row:
+            continue
+        ratio = row.get("ratio")
+        if isinstance(ratio, (int, float)) and ratio > 0:
+            out[node] = float(ratio)
+    return out

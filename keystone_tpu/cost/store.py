@@ -1,0 +1,224 @@
+"""The persistent operator-profile store: learned cost evidence on disk.
+
+One record = one JSON file = one profile key for one environment
+(backend + device kind). The same discipline as ``compile/cache.py``:
+
+* **atomic writes** — records are written to a same-directory temp file
+  and ``os.replace``d into place; a concurrent reader sees the old
+  record, the new record, or a miss — never a torn file.
+* **corruption tolerance** — a magic marker, the embedded key, and a
+  sha256 checksum of the canonical record JSON are validated on load;
+  any mismatch (truncation, bit rot, a foreign file) logs, best-effort
+  deletes the file, and reports a miss so the caller falls back to
+  sampling.
+* **environment isolation** — the filename embeds a digest of the
+  producing environment and the payload embeds the environment itself,
+  so a CPU-backend profile can never be read as TPU evidence (and two
+  backends' stores coexist in one directory).
+
+Key namespaces (see ``keystone_tpu/cost/__init__.py`` for the layout):
+
+* ``op/<OperatorClass>`` — class-level throughput evidence (EWMA
+  seconds-per-cost-unit for solvers, seconds/bytes-per-item for
+  transformers), the KeystoneML "operator profile".
+* ``solver/<graph-fp>`` — the shape signature + chosen solver observed
+  for one pipeline's auto-solver node.
+* ``plan/<graph-fp>`` — per-node observed costs for one pipeline, the
+  evidence the cache planner re-plans from without sampling.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = "KSPROF1"
+_SUFFIX = ".json"
+_SAFE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def profile_environment() -> Dict[str, str]:
+    """What must match for a profile to be applicable evidence: the
+    backend and the device kind. Narrower than the AOT cache's key (jax
+    version changes invalidate an executable, not a throughput
+    measurement)."""
+    import jax
+
+    devices = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "unknown",
+    }
+
+
+def _canonical(record: Dict) -> str:
+    return json.dumps(record, sort_keys=True, separators=(",", ":"))
+
+
+class ProfileStore:
+    """Directory-rooted, multi-process-safe profile record store."""
+
+    def __init__(self, root: str, env: Optional[Dict[str, str]] = None):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        # env resolves lazily: profile_environment() touches jax.devices(),
+        # which initializes the backend — construction happens at
+        # configure() time, BEFORE --backend/--cpuDevices pick a platform
+        self._env = dict(env) if env is not None else None
+        self._digest: Optional[str] = None
+        os.makedirs(self.root, exist_ok=True)
+
+    @property
+    def env(self) -> Dict[str, str]:
+        if self._env is None:
+            self._env = profile_environment()
+        return self._env
+
+    @property
+    def _env_digest(self) -> str:
+        if self._digest is None:
+            self._digest = hashlib.sha256(
+                _canonical(self.env).encode()
+            ).hexdigest()[:8]
+        return self._digest
+
+    # -- paths ----------------------------------------------------------
+
+    def path(self, key: str) -> str:
+        if not key:
+            raise ValueError(f"invalid profile key {key!r}")
+        safe = _SAFE.sub("_", key.replace("/", "."))
+        digest = hashlib.sha256(key.encode()).hexdigest()[:12]
+        return os.path.join(
+            self.root, f"{safe}-{digest}-{self._env_digest}{_SUFFIX}"
+        )
+
+    # -- store ----------------------------------------------------------
+
+    def store(self, key: str, record: Dict) -> str:
+        """Atomically persist one record. IO failures propagate — callers
+        treat a failed store as non-fatal (planning still works, it just
+        stays sampled)."""
+        doc = {
+            "magic": _MAGIC,
+            "key": key,
+            "env": self.env,
+            "record": record,
+            "sha256": hashlib.sha256(_canonical(record).encode()).hexdigest(),
+        }
+        path = self.path(key)
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp-prof-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, sort_keys=True, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic on POSIX: readers see old XOR new
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    # -- load -----------------------------------------------------------
+
+    def load(self, key: str) -> Optional[Dict]:
+        """Load + validate one record. Returns None on miss, corruption,
+        or environment mismatch — never raises for on-disk problems."""
+        path = self.path(key)
+        try:
+            with open(path, "r") as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            self._discard(path, "unreadable/corrupt")
+            return None
+        record = self._validate(key, doc)
+        if record is None:
+            self._discard(path, "corrupt")
+            return None
+        if doc.get("env") != self.env:
+            # evidence from another backend/device — stale, not corrupt;
+            # unreachable through path() (the filename embeds the env
+            # digest) but guards hand-copied files
+            logger.info(
+                "profile store: environment mismatch for %s (%s, want %s)",
+                key, doc.get("env"), self.env,
+            )
+            return None
+        return record
+
+    @staticmethod
+    def _validate(key: str, doc) -> Optional[Dict]:
+        try:
+            if not isinstance(doc, dict) or doc.get("magic") != _MAGIC:
+                return None
+            if doc.get("key") != key:
+                return None  # renamed / foreign file
+            record = doc.get("record")
+            if not isinstance(record, dict):
+                return None
+            digest = hashlib.sha256(_canonical(record).encode()).hexdigest()
+            if doc.get("sha256") != digest:
+                return None  # bit rot / torn copy
+            return record
+        except Exception:
+            return None
+
+    def _discard(self, path: str, why: str) -> None:
+        logger.warning("profile store: discarding %s record %s", why, path)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    # -- read-modify-write ---------------------------------------------
+
+    def update(
+        self, key: str, fn: Callable[[Optional[Dict]], Dict]
+    ) -> Optional[Dict]:
+        """Read-modify-write one record: ``fn`` receives the current
+        record (or None on miss) and returns the replacement. Concurrent
+        writers are safe (atomic replace; last writer wins per file).
+        Store failures log and return None — profile updates must never
+        fail a fit."""
+        try:
+            record = fn(self.load(key))
+            self.store(key, record)
+            return record
+        except Exception:
+            logger.warning("profile store: update of %s failed", key,
+                           exc_info=True)
+            return None
+
+    # -- maintenance ----------------------------------------------------
+
+    def keys(self) -> List[str]:
+        """Embedded keys of every valid record in THIS environment."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(_SUFFIX) or name.startswith("."):
+                continue
+            try:
+                with open(os.path.join(self.root, name), "r") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            key = doc.get("key")
+            if isinstance(key, str) and self._validate(key, doc) is not None \
+                    and doc.get("env") == self.env:
+                out.append(key)
+        return sorted(out)

@@ -77,6 +77,28 @@ def _block_means(blocks, y):
     return [jnp.mean(b, axis=0) for b in blocks], jnp.mean(y, axis=0)
 
 
+def cost_signature(
+    n: int, d: int, k: int, block_size: int, num_iter: int, machines: int = 1
+) -> dict:
+    """Work terms for pricing a BCD solve: ``num_iter`` sweeps, each
+    scanning the data once per block and touching only a (block, k) slab
+    of model state (parity: BlockLinearMapper.scala:268-282; consumed by
+    ``keystone_tpu.cost``)."""
+    import math
+
+    return {
+        # every term carries num_iter so combine_cost's max() distributes
+        # exactly like the reference's num_iter * (max(...) + net) form
+        "flops": num_iter * n * d * (block_size + k) / machines,
+        "bytes": num_iter * (n * d / machines + d * k),
+        "network": (
+            2.0 * num_iter * d * (block_size + k)
+            * math.log2(max(machines, 2))
+        ),
+        "passes": 3 * num_iter + 1,
+    }
+
+
 def solve_blockwise_l2(
     blocks: Sequence[jax.Array],
     y: jax.Array,

@@ -119,6 +119,21 @@ def solve_least_squares_streaming(
     return solve_spd(G, C, reg)
 
 
+def cost_signature(n: int, d: int, k: int, machines: int = 1) -> dict:
+    """Work terms for pricing an exact normal-equations solve — the
+    inputs to the cost model's ``max(cpu·flops, mem·bytes) + net·network``
+    form (parity: LinearMapper.scala:100-117; consumed by
+    ``keystone_tpu.cost``). One pass over the data; the Gram/cross GEMMs
+    dominate, the d×d Cholesky is shape-independent noise at solver
+    scales."""
+    return {
+        "flops": n * d * (d + k) / machines,
+        "bytes": n * d / machines + d * d,
+        "network": d * (d + k),
+        "passes": 1,
+    }
+
+
 def solve_least_squares(
     A: jax.Array,
     b: jax.Array,

@@ -81,6 +81,21 @@ def tsqr_r(A, mesh: Optional[Mesh] = None) -> jax.Array:
     return _tsqr_fn(mesh)(A)
 
 
+def cost_signature(n: int, d: int, k: int = 0, machines: int = 1) -> dict:
+    """Work terms for pricing a TSQR factorization of an (n, d+k)
+    augmented design matrix (consumed by ``keystone_tpu.cost``). A
+    Householder QR pays ~2·n·w² flops for width w = d+k — twice the Gram
+    route's contraction — in exchange for never squaring the condition
+    number; the reduction gathers one w×w factor per shard."""
+    w = d + k
+    return {
+        "flops": 2.0 * n * w * w / machines + machines * float(w) ** 3,
+        "bytes": n * w / machines + w * w,
+        "network": machines * w * w,
+        "passes": 1,
+    }
+
+
 @jax.jit
 def _qr_r(chunk):
     return jnp.linalg.qr(chunk, mode="r")

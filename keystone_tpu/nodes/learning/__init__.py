@@ -31,6 +31,7 @@ from .linear import (
     LinearMapEstimator,
     LinearMapper,
     SparseLinearMapper,
+    TSQRLeastSquaresEstimator,
 )
 from .pca import (
     ApproximatePCAEstimator,
@@ -71,6 +72,7 @@ __all__ = [
     "LinearMapEstimator",
     "LinearMapper",
     "SparseLinearMapper",
+    "TSQRLeastSquaresEstimator",
     "ApproximatePCAEstimator",
     "BatchPCATransformer",
     "ColumnPCAEstimator",

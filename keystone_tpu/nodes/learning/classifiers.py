@@ -22,7 +22,12 @@ from ...workflow.transformer import LabelEstimator, Transformer
 from ...utils.params import as_param
 from .cost import CostModel
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, minimize_lbfgs
-from .linear import BlockLeastSquaresEstimator, LinearMapEstimator, LinearMapper
+from .linear import (
+    BlockLeastSquaresEstimator,
+    LinearMapEstimator,
+    LinearMapper,
+    TSQRLeastSquaresEstimator,
+)
 
 
 class NaiveBayesModel(Transformer):
@@ -242,10 +247,18 @@ class LinearDiscriminantAnalysis(LabelEstimator):
 
 class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
     """Cost-model auto-selecting least squares solver
-    (parity: LeastSquaresEstimator.scala:26-88; option set preserved:
+    (parity: LeastSquaresEstimator.scala:26-88; option set preserved —
     dense LBFGS, sparse LBFGS, block solver (1000, 3), exact normal
-    equations). Participates in graph-level NodeOptimizationRule via
-    ``sample_optimize`` (parity: OptimizableNodes.scala:27-40)."""
+    equations — plus the augmented-TSQR exact solver). Participates in
+    graph-level NodeOptimizationRule via ``sample_optimize`` (parity:
+    OptimizableNodes.scala:27-40).
+
+    Selection runs through :class:`keystone_tpu.cost.SolverChooser`: cold
+    it ranks by each option's analytic ``cost`` units (identical to the
+    reference's argmin); with a profile store configured
+    (``KEYSTONE_PROFILE_DIR``) units are converted to predicted seconds
+    via learned per-class throughput, and chunked (out-of-core) inputs
+    restrict the field to solvers with a streaming fit path."""
 
     def __init__(self, lam: float = 0.0, num_machines: Optional[int] = None,
                  cpu_weight: float = 3.8e-4, mem_weight: float = 2.9e-1,
@@ -260,6 +273,7 @@ class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
             SparseLBFGSwithL2(reg_param=lam, num_iterations=20),
             BlockLeastSquaresEstimator(1000, 3, lam=lam),
             LinearMapEstimator(lam=lam),
+            TSQRLeastSquaresEstimator(lam=lam),
         ]
         self.default = self.options[0]
 
@@ -267,18 +281,26 @@ class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
     def weight(self) -> int:
         return self.default.weight
 
-    def sample_optimize(self, samples, num_items: int):
+    def sample_optimize(self, samples, num_items: int, chunked: bool = False):
         """Graph-level entry: pick the concrete solver from dependency
         samples + the full dataset size."""
         data_sample, label_sample = samples[0], samples[1]
-        return self.optimize(data_sample, label_sample, total_n=num_items)
+        return self.optimize(
+            data_sample, label_sample, total_n=num_items, chunked=chunked
+        )
 
-    def optimize(self, sample: Dataset, sample_labels: Dataset,
-                 total_n: Optional[int] = None) -> LabelEstimator:
+    def shape_from_samples(
+        self, samples, num_items: int, chunked: bool = False
+    ):
+        """Distill dependency samples into the chooser's shape signature
+        (n is the FULL dataset size — selecting on the raw sample size
+        skews toward small-n regimes; the reference uses
+        numPerPartition × machines, LeastSquaresEstimator.scala:63-66)."""
+        from ...cost import ShapeSignature
         from ...data.sparse import SparseRows
 
-        sample = Dataset.of(sample)
-        sample_labels = Dataset.of(sample_labels)
+        sample = Dataset.of(samples[0])
+        sample_labels = Dataset.of(samples[1])
         if isinstance(sample.payload, SparseRows):
             sparsity = sample.payload.density()
             d = sample.payload.num_features
@@ -293,20 +315,44 @@ class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
             else:
                 sparsity = 1.0
                 d = np.asarray(first).shape[-1]
-        # Scale the sample up to the full dataset size — selecting on the
-        # raw sample size skews toward small-n regimes (the reference uses
-        # numPerPartition × machines, LeastSquaresEstimator.scala:63-66).
-        n = total_n if total_n is not None else len(sample)
+        n = num_items if num_items else len(sample)
         k = np.asarray(sample_labels.first()).shape[-1]
-        machines = self.num_machines or default_mesh().size
-        return min(
-            self.options,
-            key=lambda s: s.cost(
-                n, d, k, sparsity, machines,
-                self.cpu_weight, self.mem_weight, self.network_weight,
-            ),
+        return ShapeSignature(
+            n=int(n), d=int(d), k=int(k), sparsity=float(sparsity),
+            chunked=bool(chunked),
+            machines=int(self.num_machines or default_mesh().size),
         )
 
+    def choose_solver(self, shape, node_id: Optional[str] = None):
+        """Run the cost-model chooser over the option set; returns the
+        full :class:`~keystone_tpu.cost.SolverChoice` (pricing table
+        included) for the given shape signature."""
+        from ...cost import SolverChooser
+
+        return SolverChooser().choose(
+            self.options, shape,
+            self.cpu_weight, self.mem_weight, self.network_weight,
+            node_id=node_id, owner_label=type(self).__name__,
+        )
+
+    def optimize(self, sample: Dataset, sample_labels: Dataset,
+                 total_n: Optional[int] = None,
+                 chunked: bool = False) -> LabelEstimator:
+        shape = self.shape_from_samples(
+            [sample, sample_labels],
+            total_n if total_n is not None else len(Dataset.of(sample)),
+            chunked=chunked,
+        )
+        return self.choose_solver(shape).chosen
+
     def fit(self, data: Dataset, labels: Dataset):
-        solver = self.optimize(Dataset.of(data), Dataset.of(labels))
-        return solver.fit(Dataset.of(data), Dataset.of(labels))
+        from ...data.chunked import ChunkedDataset
+
+        chunked = isinstance(data, ChunkedDataset)
+        sample = data.take(24) if chunked else Dataset.of(data)
+        solver = self.optimize(
+            sample, Dataset.of(labels), total_n=len(Dataset.of(data)),
+            chunked=chunked,
+        )
+        return solver.fit(data if chunked else Dataset.of(data),
+                          Dataset.of(labels))

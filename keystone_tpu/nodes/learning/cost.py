@@ -13,7 +13,17 @@ from __future__ import annotations
 
 
 class CostModel:
-    """Estimated cost of fitting this solver on (n, d, k) data."""
+    """Estimated cost of fitting this solver on (n, d, k) data.
+
+    ``cost`` returns analytic *units* in the reference's functional form;
+    the ``keystone_tpu.cost`` subsystem converts units to predicted
+    wall-clock seconds via learned per-class throughput (see
+    ``cost/model.py``) and restricts chunked inputs to solvers that set
+    ``supports_streaming``."""
+
+    #: True when ``fit`` accepts a ChunkedDataset without materializing
+    #: the full design matrix (the out-of-core / laned path)
+    supports_streaming = False
 
     def cost(
         self,
@@ -27,6 +37,23 @@ class CostModel:
         network_weight: float,
     ) -> float:
         raise NotImplementedError
+
+
+def combine_cost(
+    signature: dict,
+    cpu_weight: float,
+    mem_weight: float,
+    network_weight: float,
+) -> float:
+    """``max(cpu·flops, mem·bytes) + net·network`` over one solver's work
+    terms (see ``linalg.*.cost_signature``)."""
+    return (
+        max(
+            cpu_weight * signature["flops"],
+            mem_weight * signature["bytes"],
+        )
+        + network_weight * signature["network"]
+    )
 
 
 # Default weights, recalibratable on real hardware. Ratios matter, absolute

@@ -27,7 +27,7 @@ from ...linalg import solve_blockwise_l2, solve_least_squares
 from ...parallel.mesh import shard_batch
 from ...utils.params import as_param
 from ...workflow.transformer import LabelEstimator, Transformer
-from .cost import CostModel
+from .cost import CostModel, combine_cost
 
 
 class LinearMapper(Transformer):
@@ -50,12 +50,21 @@ class LinearMapper(Transformer):
 
 class LinearMapEstimator(LabelEstimator, CostModel):
     """Exact OLS via mesh normal equations
-    (parity: LinearMapper.scala:69-100)."""
+    (parity: LinearMapper.scala:69-100). Chunked inputs stream: a means
+    pass, then centered (A, y) chunks through the laned Gram accumulator
+    (``solve_least_squares_streaming``) — the exact solve never
+    materializes the design matrix."""
+
+    supports_streaming = True
 
     def __init__(self, lam: Optional[float] = None):
         self.lam = lam
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        from ...data.chunked import ChunkedDataset
+
+        if isinstance(data, ChunkedDataset):
+            return self._fit_streaming(data, labels)
         A = shard_batch(data.to_array().astype(jnp.float32))
         b = shard_batch(labels.to_array().astype(jnp.float32))
         a_mean = jnp.mean(A, axis=0)
@@ -63,14 +72,49 @@ class LinearMapEstimator(LabelEstimator, CostModel):
         W = solve_least_squares(A - a_mean, b - b_mean, reg=self.lam or 0.0)
         return LinearMapper(W, b=b_mean, feature_mean=a_mean)
 
+    def _fit_streaming(self, data, labels: Dataset) -> LinearMapper:
+        """Out-of-core exact solve: one pass for column means, one laned
+        Gram/cross pass over centered chunks (same two-pass shape as the
+        streaming BCD path; collectives O(1) per scan)."""
+        from ...linalg import solve_least_squares_streaming
+        from ...linalg.bcd import stream_column_means
+        from ...utils.timing import phase
+
+        y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        with phase("linear_map.stream_center") as out:
+            a_mean, n = stream_column_means(data.raw_chunks)
+            if n != y.shape[0]:
+                raise ValueError(
+                    f"chunked features have {n} rows, labels {y.shape[0]}"
+                )
+            y_mean = jnp.mean(y, axis=0)
+            out.append(y_mean)
+
+        def centered():
+            offset = 0
+            for chunk in data.raw_chunks():
+                chunk = jnp.asarray(chunk, dtype=jnp.float32)
+                rows = int(chunk.shape[0])
+                yield (
+                    chunk - a_mean,
+                    y[offset : offset + rows] - y_mean,
+                )
+                offset += rows
+
+        with phase("linear_map.stream_solve") as out:
+            W = solve_least_squares_streaming(centered(), reg=self.lam or 0.0)
+            out.append(W)
+        return LinearMapper(W, b=y_mean, feature_mean=a_mean)
+
     def cost(self, n, d, k, sparsity, num_machines,
              cpu_weight, mem_weight, network_weight):
         # parity: LinearMapper.scala:100-117
-        flops = n * d * (d + k) / num_machines
-        bytes_scanned = n * d / num_machines + d * d
-        network = d * (d + k)
-        return max(cpu_weight * flops, mem_weight * bytes_scanned) \
-            + network_weight * network
+        from ...linalg.normal_equations import cost_signature
+
+        return combine_cost(
+            cost_signature(n, d, k, num_machines),
+            cpu_weight, mem_weight, network_weight,
+        )
 
 
 class BlockLinearMapper(Transformer):
@@ -126,6 +170,8 @@ class BlockLinearMapper(Transformer):
 class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
     """Block-coordinate-descent least squares — the workhorse solver
     (parity: BlockLinearMapper.scala:199-283)."""
+
+    supports_streaming = True
 
     def __init__(self, block_size: int, num_iter: int, lam: float = 0.0,
                  num_features: Optional[int] = None):
@@ -289,14 +335,122 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
     def cost(self, n, d, k, sparsity, num_machines,
              cpu_weight, mem_weight, network_weight):
         # parity: BlockLinearMapper.scala:268-282
-        import math
+        from ...linalg.bcd import cost_signature
 
-        flops = n * d * (self.block_size + k) / num_machines
-        bytes_scanned = n * d / num_machines + d * k
-        network = 2.0 * d * (self.block_size + k) * math.log2(max(num_machines, 2))
-        return self.num_iter * (
-            max(cpu_weight * flops, mem_weight * bytes_scanned)
-            + network_weight * network
+        return combine_cost(
+            cost_signature(
+                n, d, k, self.block_size, self.num_iter, num_machines
+            ),
+            cpu_weight, mem_weight, network_weight,
+        )
+
+
+class TSQRLeastSquaresEstimator(LabelEstimator, CostModel):
+    """Exact least squares via tall-skinny QR of the AUGMENTED design
+    matrix — the numerically robust sibling of the normal equations.
+
+    Parity root: mlmatrix's TSQR (DistributedPCA.scala:48 uses qrR); the
+    reference never wires it into LeastSquaresEstimator's option set, but
+    the factorization is the classic cure for the Gram route squaring the
+    condition number. One QR of ``[A−μ | y−ν ; √λ·I | 0]`` yields an
+    upper-triangular ``R`` whose blocks satisfy ``R₁₁ᵀR₁₁ = AᵀA + λI``
+    and ``R₁₁ᵀR₁₂ = Aᵀy`` (centered), so the solution is ONE triangular
+    solve ``W = R₁₁⁻¹R₁₂`` — no Gram matrix ever forms. Costs ~2× the
+    Gram contraction in flops (see ``linalg.tsqr.cost_signature``): the
+    cost model prefers it only when learned profiles or conditioning
+    evidence say so.
+
+    Chunked inputs stream through :func:`linalg.tsqr.tsqr_r_streaming`
+    (per-lane R folds, one cross-mesh gather at finalize), so the exact
+    QR solve is available out-of-core too.
+    """
+
+    supports_streaming = True
+
+    def __init__(self, lam: float = 0.0):
+        self.lam = lam
+
+    @staticmethod
+    def _solve_from_r(R, d: int):
+        from jax.scipy.linalg import solve_triangular
+
+        return solve_triangular(R[:d, :d], R[:d, d:], lower=False)
+
+    def _reg_rows(self, d: int, k: int):
+        if not self.lam:
+            return None
+        return jnp.concatenate(
+            [
+                jnp.sqrt(jnp.float32(self.lam)) * jnp.eye(d, dtype=jnp.float32),
+                jnp.zeros((d, k), dtype=jnp.float32),
+            ],
+            axis=1,
+        )
+
+    def fit(self, data, labels: Dataset) -> LinearMapper:
+        from ...data.chunked import ChunkedDataset
+        from ...linalg.tsqr import tsqr_r
+
+        if isinstance(data, ChunkedDataset):
+            return self._fit_streaming(data, labels)
+        A = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+        y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        a_mean = jnp.mean(A, axis=0)
+        y_mean = jnp.mean(y, axis=0)
+        d, k = A.shape[1], y.shape[1]
+        aug = jnp.concatenate([A - a_mean, y - y_mean], axis=1)
+        reg = self._reg_rows(d, k)
+        if reg is not None:
+            aug = jnp.concatenate([aug, reg], axis=0)
+        W = self._solve_from_r(tsqr_r(aug), d)
+        return LinearMapper(W, b=y_mean, feature_mean=a_mean)
+
+    def _fit_streaming(self, data, labels: Dataset) -> LinearMapper:
+        """Means pass, then centered augmented chunks through the laned
+        streaming TSQR; the √λ regularization rows ride as a final chunk
+        (``qr([A; √λI])`` has the regularized Gram as RᵀR)."""
+        from ...linalg.bcd import stream_column_means
+        from ...linalg.tsqr import tsqr_r_streaming
+        from ...utils.timing import phase
+
+        y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        with phase("tsqr_ls.stream_center") as out:
+            a_mean, n = stream_column_means(data.raw_chunks)
+            if n != y.shape[0]:
+                raise ValueError(
+                    f"chunked features have {n} rows, labels {y.shape[0]}"
+                )
+            y_mean = jnp.mean(y, axis=0)
+            out.append(y_mean)
+        d = int(a_mean.shape[0])
+        k = int(y.shape[1])
+        reg = self._reg_rows(d, k)
+
+        def augmented():
+            offset = 0
+            for chunk in data.raw_chunks():
+                chunk = jnp.asarray(chunk, dtype=jnp.float32)
+                rows = int(chunk.shape[0])
+                yield jnp.concatenate(
+                    [chunk - a_mean, y[offset : offset + rows] - y_mean],
+                    axis=1,
+                )
+                offset += rows
+            if reg is not None:
+                yield reg
+
+        with phase("tsqr_ls.stream_solve") as out:
+            W = self._solve_from_r(tsqr_r_streaming(augmented), d)
+            out.append(W)
+        return LinearMapper(W, b=y_mean, feature_mean=a_mean)
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        from ...linalg.tsqr import cost_signature
+
+        return combine_cost(
+            cost_signature(n, d, k, num_machines),
+            cpu_weight, mem_weight, network_weight,
         )
 
 

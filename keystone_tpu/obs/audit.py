@@ -22,7 +22,7 @@ from .tracer import Tracer
 logger = logging.getLogger(__name__)
 
 
-def observed_by_node(tracer: Tracer) -> Dict[str, dict]:
+def observed_by_node(tracer: Tracer, start: int = 0) -> Dict[str, dict]:
     """Aggregate executor spans per DAG node id: observed EXCLUSIVE compute
     seconds, max materialized bytes, and hit/miss counts.
 
@@ -31,8 +31,13 @@ def observed_by_node(tracer: Tracer) -> Dict[str, dict]:
     estimates are per-node. Comparing inclusive observations against
     exclusive estimates would flag every downstream node as
     mis-extrapolated, so each span's direct-children time is subtracted
-    first."""
-    spans = tracer.spans()
+    first.
+
+    ``start`` restricts the join to spans recorded at index >= start —
+    a long-lived process tracer holds every fit's spans, and NodeIds are
+    small per-graph ints, so an unwindowed join would merge observations
+    across fits and pipelines."""
+    spans = tracer.spans()[start:]
     child_seconds: Dict[int, float] = {}
     for sp in spans:
         if sp.parent_id is not None:
@@ -86,25 +91,37 @@ def cache_audit(tracer: Optional[Tracer] = None) -> List[dict]:
     rows = []
     for node_id, est in tracer.estimates.items():
         obs = observed.get(node_id)
-        rows.append(
-            {
-                "node": node_id,
-                "label": est["label"],
-                "cacher": est["cacher"],
-                "est_seconds": est["est_seconds"],
-                "obs_seconds": None if obs is None else round(obs["seconds"], 4),
-                "seconds_ratio": _ratio(
-                    None if obs is None else obs["seconds"], est["est_seconds"]
-                ),
-                "est_bytes": est["est_bytes"],
-                "obs_bytes": None if obs is None else obs["bytes"],
-                "bytes_ratio": _ratio(
-                    None if obs is None else obs["bytes"], est["est_bytes"]
-                ),
-                "cache_hits": 0 if obs is None else obs["hits"],
-                "observed": obs is not None,
-            }
-        )
+        row = {
+            "node": node_id,
+            "label": est["label"],
+            "cacher": est["cacher"],
+            # "node" rows come from the cache planner; "solver" rows from
+            # the cost-model chooser (solver/estimator nodes are audited
+            # too — their estimate is the chooser's predicted fit time)
+            "kind": est.get("kind", "node"),
+            "est_seconds": est["est_seconds"],
+            "obs_seconds": None if obs is None else round(obs["seconds"], 4),
+            "seconds_ratio": _ratio(
+                None if obs is None else obs["seconds"], est["est_seconds"]
+            ),
+            "est_bytes": est["est_bytes"],
+            "obs_bytes": None if obs is None else obs["bytes"],
+            "bytes_ratio": _ratio(
+                None if obs is None else obs["bytes"], est["est_bytes"]
+            ),
+            "cache_hits": 0 if obs is None else obs["hits"],
+            "observed": obs is not None,
+        }
+        if est.get("kind") == "solver":
+            row["solver"] = est.get("solver")
+            row["source"] = est.get("source")
+            row["alternatives"] = est.get("alternatives")
+            solver_est = est.get("solver_est_seconds")
+            row["solver_est_seconds"] = solver_est
+            row["solver_seconds_ratio"] = _ratio(
+                None if obs is None else obs["seconds"], solver_est
+            )
+        rows.append(row)
     rows.sort(
         key=lambda r: (not r["cacher"], -(r["est_seconds"] or 0.0))
     )
@@ -127,7 +144,10 @@ def log_cache_audit(tracer: Optional[Tracer] = None) -> List[dict]:
             "  node %-4s %-40s %s est %ss/%sB observed %ss/%sB "
             "(ratio t=%s mem=%s, hits=%d)%s",
             r["node"],
-            r["label"][:40],
+            (
+                f"[solver:{r.get('source', '?')}] {r['label']}"
+                if r["kind"] == "solver" else r["label"]
+            )[:40],
             "[cached]" if r["cacher"] else "        ",
             fmt(r["est_seconds"]),
             fmt(r["est_bytes"]),

@@ -86,6 +86,10 @@ class Tracer:
         #: node_id -> estimate row recorded by the autocache planner
         #: (see obs/audit.py for the estimate-vs-observed feedback loop)
         self._estimates: Dict[str, dict] = {}
+        #: bumped at each optimizer pass (RuleExecutor.execute): NodeIds
+        #: are small per-graph ints, so a long-lived tracer must not merge
+        #: a NEW pass's estimate for id "3" into a PREVIOUS pipeline's row
+        self._plan_epoch = 0
         _install_compile_listener()
 
     # -- span recording -------------------------------------------------
@@ -267,19 +271,46 @@ class Tracer:
         est_seconds: Optional[float] = None,
         est_bytes: Optional[float] = None,
         cacher: bool = False,
+        **extras,
     ) -> None:
+        """Record one planner estimate for a DAG node. ``extras`` carry
+        planner-specific context into the audit rows verbatim — e.g. the
+        solver chooser's ``kind="solver"``, chosen class, pricing
+        ``source``, and per-option ``alternatives``. Re-recording the
+        same node id within ONE planning pass overwrites (last planner
+        wins), preserving any prior extras the new record doesn't name;
+        a row left over from an EARLIER pass (same small-int node id,
+        different graph) is replaced wholesale so stale solver extras
+        can't leak into the new pipeline's audit."""
         with self._lock:
-            self._estimates[str(node_id)] = {
-                "label": label,
-                "est_seconds": est_seconds,
-                "est_bytes": est_bytes,
-                "cacher": bool(cacher),
-            }
+            row = self._estimates.get(str(node_id), {})
+            if row.get("_epoch") != self._plan_epoch:
+                row = {}
+            row.update(
+                {
+                    "label": label,
+                    "est_seconds": est_seconds,
+                    "est_bytes": est_bytes,
+                    "cacher": bool(cacher),
+                    "_epoch": self._plan_epoch,
+                    **extras,
+                }
+            )
+            self._estimates[str(node_id)] = row
+
+    def begin_plan_epoch(self) -> None:
+        """Mark the start of a new optimizer planning pass (see
+        :meth:`record_node_estimate`)."""
+        with self._lock:
+            self._plan_epoch += 1
 
     @property
     def estimates(self) -> Dict[str, dict]:
         with self._lock:
-            return dict(self._estimates)
+            return {
+                k: {kk: vv for kk, vv in row.items() if kk != "_epoch"}
+                for k, row in self._estimates.items()
+            }
 
 
 # -- process-global wiring --------------------------------------------------
@@ -306,6 +337,34 @@ def install(tracer: Tracer) -> Tracer:
     global _current
     _current = tracer
     return tracer
+
+
+_install_lock = threading.Lock()
+
+
+def install_if_absent(tracer: Tracer) -> Optional[Tracer]:
+    """Install ``tracer`` only if no tracer is currently installed;
+    returns it if installed, None if another tracer already holds the
+    slot. Lets concurrent fit-local observation windows (Pipeline.fit
+    with a profile store) race safely: exactly one wins the slot."""
+    global _current
+    with _install_lock:
+        if _current is not None:
+            return None
+        _current = tracer
+        return tracer
+
+
+def uninstall(tracer: Tracer) -> bool:
+    """Remove ``tracer`` only if it is still the installed one; returns
+    whether it was removed. The safe inverse of :func:`install_if_absent`
+    — never tears down a tracer some other thread installed later."""
+    global _current
+    with _install_lock:
+        if _current is not tracer:
+            return False
+        _current = None
+        return True
 
 
 def start(path: Optional[str] = None) -> Tracer:

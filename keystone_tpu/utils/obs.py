@@ -25,6 +25,10 @@ Environment switches (read by the CLI and by ``configure(None)``):
   executable cache (``keystone_tpu.compile``): fitted-pipeline compiles
   load previously exported executables instead of re-tracing, and jax's
   persistent compilation cache is layered underneath.
+* ``KEYSTONE_PROFILE_DIR=/path/dir`` — install the persistent operator
+  profile store (``keystone_tpu.cost``): fits learn per-operator
+  throughput from traced runs and the second fit of any pipeline plans
+  its solver choice + cache plan from evidence with zero sampling.
 """
 
 from __future__ import annotations
@@ -72,6 +76,7 @@ def configure(
     profile: Optional[bool] = None,
     trace: Optional[str] = None,
     aot_cache: Optional[str] = None,
+    profiles: Optional[str] = None,
 ) -> None:
     """Configure logging (and optionally phase profiling) process-wide.
 
@@ -84,9 +89,11 @@ def configure(
     ``None`` follows ``KEYSTONE_TRACE`` (off unless set). ``aot_cache``
     is a directory path enabling the persistent AOT executable cache
     (``keystone_tpu.compile``); ``None`` follows ``KEYSTONE_AOT_CACHE``
-    (off unless set). Idempotent; later calls re-level the root handler
-    and re-apply the profiling switch, and an already-installed tracer
-    is kept (spans survive).
+    (off unless set). ``profiles`` is a directory path enabling the
+    persistent operator profile store (``keystone_tpu.cost``); ``None``
+    follows ``KEYSTONE_PROFILE_DIR`` (off unless set). Idempotent; later
+    calls re-level the root handler and re-apply the profiling switch,
+    and an already-installed tracer is kept (spans survive).
     """
     global _configured
     from_env = level is None
@@ -139,6 +146,14 @@ def configure(
         _compile_mod.configure(aot_cache)
     else:
         _compile_mod.get_cache()
+
+    # profile store: same keep-unless-explicit contract as the AOT cache
+    from .. import cost as _cost_mod
+
+    if profiles is not None:
+        _cost_mod.configure(profiles)
+    else:
+        _cost_mod.get_store()
 
 
 def export_trace(path: Optional[str] = None) -> Optional[str]:

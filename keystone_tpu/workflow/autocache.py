@@ -78,6 +78,8 @@ def _profile_at_scale(graph: Graph, sample_size: int) -> Dict[NodeId, Profile]:
     # branches' intermediates at once — KEYSTONE_EXEC_WORKERS bounds it.
     executor = GraphExecutor(sampled, optimize=False, parallel=False)
     profiles: Dict[NodeId, Profile] = {}
+    from .. import cost as cost_mod
+
     # profiling pulls run at sampled scale over a TRUNCATED graph whose
     # node ids collide with the production graph's — suspend tracing so
     # they can't pollute the real span registry / audit observations
@@ -87,6 +89,7 @@ def _profile_at_scale(graph: Graph, sample_size: int) -> Dict[NodeId, Profile]:
                 continue
             try:
                 t0 = time.perf_counter_ns()
+                cost_mod.count_sampling("autocache")
                 value = executor.execute(gid).get()
                 elapsed = time.perf_counter_ns() - t0
             except Exception as e:
@@ -100,11 +103,22 @@ def profile_nodes(
     graph: Graph,
     sample_sizes: Sequence[int] = (8, 16, 24),
     full_size: Optional[int] = None,
+    calibration: Optional[Dict[NodeId, float]] = None,
 ) -> Dict[NodeId, Profile]:
     """Profile at several sample scales and fit a linear model per node,
     extrapolated to the full input size (parity: ``generalizeProfiles``,
     AutoCacheRule.scala:104-135 — same least-squares-in-scale idea, with
-    jit warmup noise damped by taking the *minimum* time per scale)."""
+    jit warmup noise damped by taking the *minimum* time per scale).
+
+    ``calibration`` holds per-node observed/estimated seconds ratios
+    measured by a previous traced run of the same pipeline
+    (``cost.replan.stored_calibration``): each node's extrapolation is
+    scaled by ITS OWN measured sample-to-full ratio rather than trusting
+    one global linear-in-n factor — nodes whose per-item cost shifts
+    between the 24-item sample scale and the real run (compile overhead
+    amortization, cache effects, batching cliffs) were the audit's worst
+    estimate-vs-observed ratios. Ratios are clamped to [1/64, 64] so one
+    corrupt observation cannot zero out or explode a plan."""
     input_size = _full_input_size(graph)
     # the truncated leaf size actually run: requested scale capped by the
     # real dataset size (otherwise the fitted slope uses a wrong Δx)
@@ -131,6 +145,9 @@ def profile_nodes(
         else:
             scale = target / xs[-1]
             ns, mem = ts[-1] * scale, bs[-1] * scale
+        ratio = (calibration or {}).get(n)
+        if ratio is not None:
+            ns *= float(np.clip(ratio, 1.0 / 64.0, 64.0))
         out[n] = Profile(float(ns), float(mem))
     return out
 
@@ -275,22 +292,72 @@ class AutoCacheRule(Rule):
     def apply(
         self, graph: Graph, annotations: Annotations
     ) -> Tuple[Graph, Annotations]:
+        from .. import cost as cost_mod
+        from ..cost import replan as cost_replan
+
+        store = cost_mod.get_store()
+        # fingerprint/topo-index once per apply: stored_profiles,
+        # calibration, persistence, and the pending-plan deposit all
+        # address the same graph identity
+        fp = cost_mod.graph_fingerprint(graph) if store is not None else None
+        index = (
+            cost_replan.topo_node_index(graph) if store is not None else None
+        )
+        plan_rec = (
+            cost_replan.load_plan_record(store, fp)
+            if store is not None else None
+        )
         profiles: Optional[Dict[NodeId, Profile]] = None
+        source = "none"
+        budget: Optional[float] = None
         if self.strategy == "aggressive":
             selected = self._select_aggressive(graph)
         else:
+            full_n = _full_input_size(graph)
             profiles = self.profiles
-            if profiles is None:
-                profiles = profile_nodes(
-                    graph, full_size=_full_input_size(graph)
+            source = "injected" if profiles is not None else source
+            if profiles is None and store is not None:
+                # a previous traced run of this pipeline left per-node
+                # OBSERVED costs — plan from evidence, zero sampling
+                profiles = cost_replan.stored_profiles(
+                    store, graph, full_n, fp=fp, index=index, rec=plan_rec
                 )
-            budget = (
+                if profiles is not None:
+                    source = "profiles"
+                    logger.info(
+                        "auto-cache: planning %d nodes from stored "
+                        "profiles (no sampling)", len(profiles),
+                    )
+            if profiles is None:
+                calibration = cost_replan.stored_calibration(
+                    store, graph, fp=fp, index=index, rec=plan_rec
+                )
+                profiles = profile_nodes(
+                    graph, full_size=full_n, calibration=calibration
+                )
+                source = "sampled+calibrated" if calibration else "sampled"
+                self._fill_from_class_throughput(graph, profiles, full_n)
+                if store is not None:
+                    # persist the sampled estimates NOW: graphs optimized
+                    # outside a fit (a prefix spliced at construction, an
+                    # apply-path plan) never reach the re-plan hook, and
+                    # without a record they would re-sample on every run.
+                    # A traced fit of the same graph later overwrites this
+                    # with observed evidence (cost/replan.py).
+                    self._persist_sampled_plan(
+                        store, graph, profiles, full_n, source, fp, index
+                    )
+            budget = float(
                 self.mem_budget_bytes
                 if self.mem_budget_bytes is not None
                 else _device_budget_bytes()
             )
-            selected = self._select_greedy(graph, profiles, float(budget))
+            selected = self._select_greedy(graph, profiles, budget)
         self._record_plan(graph, profiles, selected)
+        self._record_pending(
+            graph, profiles, selected, source, budget, fp, index
+        )
+        self._record_estimate_span(graph, profiles, selected, source)
         if selected:
             logger.info(
                 "auto-cache (%s): inserting Cacher after %d nodes (%s)",
@@ -333,6 +400,134 @@ class AutoCacheRule(Rule):
                 est_bytes=None if p is None else p.mem_bytes,
                 cacher=n in selected,
             )
+
+    @staticmethod
+    def _fill_from_class_throughput(
+        graph: Graph, profiles: Dict[NodeId, Profile], full_n: int
+    ) -> None:
+        """Price nodes the sampled profiling skipped (an upstream failure
+        at sample scale, an estimator that cannot run truncated) from the
+        store's per-operator-class throughput records — measured evidence
+        from OTHER pipelines on this backend/device kind."""
+        from .. import cost as cost_mod
+
+        estimator = cost_mod.get_estimator()
+        for n in graph.nodes:
+            if n in profiles:
+                continue
+            op = graph.get_operator(n)
+            if isinstance(op, DatasetOperator) or _is_cacher(op):
+                continue
+            priced = estimator.node_profile_ns(type(op).__name__, full_n)
+            if priced is not None:
+                profiles[n] = Profile(priced[0], priced[1])
+                logger.info(
+                    "auto-cache: priced unprofiled %s from class "
+                    "throughput evidence", op.label,
+                )
+
+    @staticmethod
+    def _persist_sampled_plan(
+        store, graph: Graph, profiles: Dict[NodeId, Profile],
+        full_n: int, source: str, fp: str, index: Dict[NodeId, int],
+    ) -> None:
+        from ..cost.replan import PLAN_VERSION
+
+        nodes = {}
+        for n in graph.nodes:
+            p = profiles.get(n)
+            if p is None:
+                continue
+            op = graph.get_operator(n)
+            nodes[str(index[n])] = {
+                "idx": index[n],
+                "label": op.label,
+                "op_class": type(op).__name__,
+                "n": max(int(full_n), 1),
+                "observed": False,
+                "seconds": round(p.ns / 1e9, 9),
+                "bytes": float(p.mem_bytes),
+            }
+        if len(nodes) != len(graph.nodes):
+            return  # partial coverage would force a re-sample anyway
+        store.update(
+            f"plan/{fp}",
+            lambda rec: {
+                "version": PLAN_VERSION,
+                "strategy": "greedy",
+                "budget": None,
+                "full_n": max(int(full_n), 1),
+                "source": source,
+                "nodes": nodes,
+            },
+        )
+
+    @staticmethod
+    def _record_pending(
+        graph: Graph,
+        profiles: Optional[Dict[NodeId, Profile]],
+        selected: set,
+        source: str,
+        budget: Optional[float],
+        fp: Optional[str],
+        index: Optional[Dict[NodeId, int]],
+    ) -> None:
+        """Deposit the cache plan into the pending re-plan (see
+        ``cost/replan.py``): graph identity, budget, every node's estimate
+        and the selection — what `finalize` joins against observations."""
+        from .. import cost as cost_mod
+        from ..cost.replan import topo_node_index
+
+        plan = cost_mod.current_plan()
+        # first deposit wins — see NodeOptimizationRule: a sub-pipeline
+        # optimized while the outer fit executes must not replace the
+        # outer fit's plan
+        if plan is None or plan.autocache is not None:
+            return
+        if index is None:
+            index = topo_node_index(graph)
+        nodes = {}
+        for n in graph.nodes:
+            op = graph.get_operator(n)
+            p = (profiles or {}).get(n)
+            nodes[str(n.id)] = {
+                "idx": index[n],
+                "label": op.label,
+                "op_class": type(op).__name__,
+                "est_ns": None if p is None else p.ns,
+                "est_bytes": None if p is None else p.mem_bytes,
+                "cacher": n in selected,
+                "leaf": isinstance(op, DatasetOperator),
+            }
+        plan.autocache = {
+            "fp": fp if fp is not None else cost_mod.graph_fingerprint(graph),
+            "graph": graph,
+            "strategy": "greedy" if budget is not None else "aggressive",
+            "budget": budget if budget is not None else 0.0,
+            "full_n": _full_input_size(graph),
+            "selected": set(selected),
+            "source": source,
+            "nodes": nodes,
+        }
+
+    @staticmethod
+    def _record_estimate_span(
+        graph: Graph,
+        profiles: Optional[Dict[NodeId, Profile]],
+        selected: set,
+        source: str,
+    ) -> None:
+        tracer = obs_tracer.current()
+        if tracer is None:
+            return
+        with tracer.span(
+            "cost.estimate",
+            op_type="AutoCacheRule",
+            source=source,
+            nodes=0 if profiles is None else len(profiles),
+            cachers=len(selected),
+        ):
+            pass
 
 
 def _full_input_size(graph: Graph) -> int:

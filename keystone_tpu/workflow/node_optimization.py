@@ -1,16 +1,25 @@
-"""Node-level implementation choice driven by data samples.
+"""Node-level implementation choice driven by data samples and profiles.
 
 Parity target: ``workflow/NodeOptimizationRule.scala`` + ``OptimizableNodes.scala``.
 An ``Optimizable`` node (e.g. the auto-solver ``LeastSquaresEstimator``, the
 PCA chooser) inspects a small sample of its input plus the full dataset size
 and returns the concrete operator to run. The rule executes the DAG on
 sampled leaf datasets to produce those samples, then swaps operators in place.
+
+Cost-model integration (``keystone_tpu.cost``): nodes exposing the
+``shape_from_samples``/``choose_solver`` protocol route through the
+:class:`~keystone_tpu.cost.SolverChooser`. When a profile store is
+configured and holds this pipeline's solver shape from a previous traced
+run, the rule plans WITHOUT executing the sampled graph at all — the
+zero-sampling second fit. Either way the decision (shape, choice, pricing)
+is deposited into the pending re-plan so the fit's observed cost feeds the
+store (``cost/replan.py``).
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 from ..data.dataset import Dataset
 from .executor import GraphExecutor
@@ -32,7 +41,11 @@ class Optimizable:
     ``sample_optimize(samples, num_items)`` receives one sampled ``Dataset``
     per dependency and the full input size, and returns the replacement
     operator (often ``self`` configured, or a different node entirely).
-    """
+
+    Nodes that additionally implement ``shape_from_samples(samples,
+    num_items, chunked=...)`` and ``choose_solver(shape, node_id=...)``
+    (the cost-model protocol) are planned through the profile-backed
+    chooser and can skip sampling entirely on evidence."""
 
     def sample_optimize(self, samples: Sequence[Dataset], num_items: int) -> Operator:
         raise NotImplementedError
@@ -63,6 +76,33 @@ def _total_items(graph: Graph, node: NodeId) -> int:
     return n
 
 
+def _chunked_input(graph: Graph, node: NodeId) -> bool:
+    """True when the node's DATA input (first dependency) flows from an
+    out-of-core ChunkedDataset leaf — the signal that restricts solver
+    choice to streaming-capable implementations."""
+    from ..data.chunked import ChunkedDataset
+
+    deps = graph.get_dependencies(node)
+    if not deps:
+        return False
+    data_dep = deps[0]
+    scope = analysis.get_ancestors(graph, data_dep) | {data_dep}
+    for anc in scope:
+        if isinstance(anc, NodeId):
+            op = graph.get_operator(anc)
+            if isinstance(op, DatasetOperator) and isinstance(
+                op.dataset, ChunkedDataset
+            ):
+                return True
+    return False
+
+
+class _SamplingFailed(Exception):
+    """A sampled-scale dependency pull failed (estimator upstream of the
+    sample path etc.) — the one condition that skips a node instead of
+    failing the optimize."""
+
+
 class NodeOptimizationRule(Rule):
     def __init__(self, sample_size: int = DEFAULT_SAMPLE_SIZE):
         self.sample_size = sample_size
@@ -78,22 +118,136 @@ class NodeOptimizationRule(Rule):
         if not optimizable:
             return graph, annotations
 
-        # sampled-scale pulls stay serial: they exist to be cheap, and the
-        # concurrent scheduler's pool would only add noise at 24 items
-        sampled = _sampled_graph(graph, self.sample_size)
-        executor = GraphExecutor(sampled, optimize=False, parallel=False)
-        for node in optimizable:
-            op = graph.get_operator(node)
+        from .. import cost as cost_mod
+
+        store = cost_mod.get_store()
+        fp: Optional[str] = None
+        index: Dict[NodeId, int] = {}
+        if store is not None:
+            fp = cost_mod.graph_fingerprint(graph)
+            from ..cost.replan import topo_node_index
+
+            index = topo_node_index(graph)
+
+        # the sampled executor is built lazily: an evidence-planned run
+        # must not pay even the construction of the truncated graph
+        executor: Optional[GraphExecutor] = None
+
+        def sampled_deps(node: NodeId):
+            nonlocal executor
+            if executor is None:
+                # sampled-scale pulls stay serial: they exist to be cheap,
+                # and the concurrent scheduler's pool would only add noise
+                # at 24 items
+                executor = GraphExecutor(
+                    _sampled_graph(graph, self.sample_size),
+                    optimize=False, parallel=False,
+                )
             deps = graph.get_dependencies(node)
             try:
                 samples = [executor.execute(d).get() for d in deps]
             except Exception as e:  # estimator upstream of sample path etc.
-                logger.warning("node optimization skipped for %s: %s", op.label, e)
-                continue
-            samples = [s if isinstance(s, Dataset) else Dataset.of([s]) for s in samples]
+                raise _SamplingFailed(e) from e
+            cost_mod.count_sampling("node_optimization", len(deps))
+            return [
+                s if isinstance(s, Dataset) else Dataset.of([s])
+                for s in samples
+            ]
+
+        for node in optimizable:
+            op = graph.get_operator(node)
             num_items = _total_items(graph, node)
-            chosen = op.sample_optimize(samples, num_items)
+            cost_protocol = hasattr(op, "shape_from_samples") and hasattr(
+                op, "choose_solver"
+            )
+            # only a failed sampled pull skips the node — a bug inside
+            # shape_from_samples/choose_solver/sample_optimize propagates
+            # (pre-cost-model behavior: selection sat outside the guard)
+            try:
+                if cost_protocol:
+                    chosen = self._choose_with_cost_model(
+                        op, graph, node, num_items, store, fp,
+                        index.get(node), sampled_deps,
+                    )
+                else:
+                    chosen = op.sample_optimize(sampled_deps(node), num_items)
+            except _SamplingFailed as e:
+                logger.warning(
+                    "node optimization skipped for %s: %s", op.label,
+                    e.__cause__,
+                )
+                continue
             if chosen is not op:
                 logger.info("node optimization: %s -> %s", op.label, chosen.label)
                 graph = graph.set_operator(node, chosen)
         return graph, annotations
+
+    @staticmethod
+    def _choose_with_cost_model(
+        op,
+        graph: Graph,
+        node: NodeId,
+        num_items: int,
+        store,
+        fp: Optional[str],
+        node_idx: Optional[int],
+        sampled_deps,
+    ):
+        """Plan one cost-protocol node: stored shape evidence when the
+        profile store has seen this pipeline (zero sampling), sampled
+        shape otherwise; either way the choice goes through the chooser
+        and into the pending re-plan."""
+        import dataclasses
+
+        from .. import cost as cost_mod
+        from ..cost import replan as cost_replan
+
+        chunked = _chunked_input(graph, node)
+        shape = None
+        source = "sampled"
+        if store is not None and fp is not None and node_idx is not None:
+            stored = cost_replan.stored_solver_shape(store, fp, node_idx)
+            if stored is not None:
+                # n, chunkedness, and machines re-derive from the CURRENT
+                # run (the dataset may have grown, the mesh may have
+                # shrunk — the store's env key is backend+device kind, not
+                # device count); d/k/sparsity are the evidence
+                from ..parallel.mesh import default_mesh
+
+                machines = int(
+                    getattr(op, "num_machines", None) or default_mesh().size
+                )
+                shape = dataclasses.replace(
+                    stored, n=int(num_items) or stored.n, chunked=chunked,
+                    machines=machines,
+                )
+                source = "profiles"
+                logger.info(
+                    "node optimization: %s planned from stored profile "
+                    "(no sampling)", op.label,
+                )
+        if shape is None:
+            shape = op.shape_from_samples(
+                sampled_deps(node), num_items, chunked=chunked
+            )
+        choice = op.choose_solver(shape, node_id=str(node.id))
+        plan = cost_mod.current_plan()
+        # first deposit wins: the OUTER fit's optimizer runs before any
+        # estimator executes, so a nested fit (or a sub-pipeline optimized
+        # during fitting) must not overwrite the plan being observed
+        if (
+            plan is not None and plan.solver is None
+            and fp is not None and node_idx is not None
+        ):
+            row = choice.costs.get(choice.label, {})
+            units = row.get("units")
+            plan.solver = {
+                "fp": fp,
+                "node_idx": int(node_idx),
+                "node_id": str(node.id),
+                "shape": shape.to_record(),
+                "chosen": choice.label,
+                "units": float(units) if units is not None else 0.0,
+                "source": source,
+            }
+        return choice.chosen

@@ -314,12 +314,52 @@ class Pipeline(Chainable):
         estimator pull below goes through ``GraphExecutor.execute``, so the
         N gather branches feeding an estimator featurize on the worker pool
         (``KEYSTONE_EXEC_WORKERS``) exactly as ``apply`` does —
-        ``KEYSTONE_PAR_EXEC=0`` serializes both."""
+        ``KEYSTONE_PAR_EXEC=0`` serializes both.
+
+        With a profile store configured (``KEYSTONE_PROFILE_DIR``) the fit
+        closes the cost-model loop: the optimizer's solver choice and cache
+        plan are deposited into a pending plan, the fit's observed per-node
+        costs are joined against it afterwards (``cost/replan.py``), and the
+        evidence persists so the NEXT fit of this pipeline plans with zero
+        sampling executions. A fit-local tracer is installed when none is
+        active — observations are what the loop learns from."""
+        from .. import cost as cost_mod
+        from ..obs import tracer as obs_tracer_mod
+
+        store = cost_mod.get_store()
         tracer = _trace_current()
-        if tracer is None:
-            return self._fit()
-        with tracer.span("pipeline.fit", op_type=type(self).__name__):
-            return self._fit()
+        own_tracer = None
+        if store is not None and tracer is None:
+            # install-if-absent: two concurrent fits race for the global
+            # slot. The loser must NOT learn: joining the winner's tracer
+            # would merge both fits' spans per small-int node id and
+            # persist cross-fit sums into both evidence records — so the
+            # loser runs a plain fit (no tracer, no pending plan) and the
+            # winner's tracer is never torn down mid-fit.
+            own_tracer = obs_tracer_mod.install_if_absent(
+                obs_tracer_mod.Tracer()
+            )
+            tracer = own_tracer
+            if own_tracer is None:
+                store = None
+        try:
+            with cost_mod.pending_plan(store) as plan:
+                if plan is not None and tracer is not None:
+                    plan.span_watermark = len(tracer.spans())
+                if tracer is None:
+                    fitted = self._fit()
+                else:
+                    with tracer.span(
+                        "pipeline.fit", op_type=type(self).__name__
+                    ):
+                        fitted = self._fit()
+                # after the fit span closes: every node span is complete,
+                # so the estimate-vs-observed join sees the whole run
+                cost_mod.finalize(plan, tracer)
+            return fitted
+        finally:
+            if own_tracer is not None:
+                obs_tracer_mod.uninstall(own_tracer)
 
     def _fit(self) -> "FittedPipeline":
         optimizer = PipelineEnv.get_or_create().optimizer

@@ -66,6 +66,13 @@ class RuleExecutor:
 
     def execute(self, graph: Graph, annotations: Optional[Annotations] = None
                 ) -> Tuple[Graph, Annotations]:
+        from ..obs import tracer as obs_tracer
+
+        t = obs_tracer.current()
+        if t is not None:
+            # one optimize pass = one estimate epoch (see
+            # Tracer.record_node_estimate)
+            t.begin_plan_epoch()
         ann = dict(annotations or {})
         for batch in self.batches():
             iteration = 0

@@ -19,7 +19,11 @@ def pipeline_env():
     PipelineContext.afterEach resetting PipelineEnv)."""
     from keystone_tpu.workflow.env import PipelineEnv
 
+    import keystone_tpu.cost as cost
+
     env = PipelineEnv.get_or_create()
     env.reset()
+    cost.reset()  # profile store is env-var-memoized like the AOT cache
     yield env
     env.reset()
+    cost.reset()

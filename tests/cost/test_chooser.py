@@ -1,0 +1,162 @@
+"""Solver selection: synthetic shapes where each solver provably wins,
+streaming restriction on chunked inputs, and evidence flipping a
+borderline case — the cost model's decision surface, pinned."""
+
+import numpy as np
+import pytest
+
+import keystone_tpu.cost as cost
+from keystone_tpu.cost import CostEstimator, ProfileStore, ShapeSignature
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LeastSquaresEstimator
+from keystone_tpu.nodes.learning.lbfgs import DenseLBFGSwithL2
+from keystone_tpu.nodes.learning.linear import (
+    BlockLeastSquaresEstimator,
+    LinearMapEstimator,
+    TSQRLeastSquaresEstimator,
+)
+
+TALL_SKINNY = ShapeSignature(n=200_000, d=64, k=8, machines=8)
+WIDE = ShapeSignature(n=100_000, d=16_384, k=8, machines=8)
+
+
+def test_tall_skinny_picks_exact_gram_family():
+    """n >> d: the one-pass exact solve (Gram/TSQR family) must beat the
+    iterative solvers — BCD pays 3 passes, LBFGS 20."""
+    auto = LeastSquaresEstimator(lam=1e-2)
+    choice = auto.choose_solver(TALL_SKINNY)
+    assert choice.label in ("LinearMapEstimator", "TSQRLeastSquaresEstimator")
+    assert choice.source == "cold"
+    # and the family ordering is strict: both exact solvers beat both
+    # iterative ones in analytic units
+    units = {lbl: row["units"] for lbl, row in choice.costs.items()}
+    assert max(
+        units["LinearMapEstimator"], units["TSQRLeastSquaresEstimator"]
+    ) < min(units["BlockLeastSquaresEstimator"], units["DenseLBFGSwithL2"])
+
+
+def test_wide_picks_bcd():
+    """d in the tens of thousands: the d×d Gram route explodes while BCD
+    touches one (block, k) slab per step."""
+    auto = LeastSquaresEstimator(lam=1e-2)
+    choice = auto.choose_solver(WIDE)
+    assert choice.label == "BlockLeastSquaresEstimator"
+    units = {lbl: row["units"] for lbl, row in choice.costs.items()}
+    assert units["BlockLeastSquaresEstimator"] < units["LinearMapEstimator"]
+    assert units["BlockLeastSquaresEstimator"] < units["TSQRLeastSquaresEstimator"]
+
+
+def test_chunked_input_restricts_to_streaming_solvers():
+    """Out-of-core inputs must never pick a solver that materializes the
+    design matrix (the LBFGS pair)."""
+    auto = LeastSquaresEstimator(lam=1e-2)
+    for shape in (TALL_SKINNY, WIDE, ShapeSignature(n=4096, d=128, k=2)):
+        chunked = ShapeSignature(
+            n=shape.n, d=shape.d, k=shape.k, chunked=True, machines=shape.machines
+        )
+        choice = auto.choose_solver(chunked)
+        assert getattr(choice.chosen, "supports_streaming", False), choice.label
+        # the LBFGS options were priced out, not silently dropped
+        assert choice.costs["DenseLBFGSwithL2"]["units"] == float("inf")
+
+
+def test_streaming_flags():
+    assert LinearMapEstimator().supports_streaming
+    assert TSQRLeastSquaresEstimator().supports_streaming
+    assert BlockLeastSquaresEstimator(256, 1).supports_streaming
+    assert not DenseLBFGSwithL2().supports_streaming
+
+
+def test_cold_choice_matches_analytic_argmin():
+    """Without evidence the chooser must reproduce the reference's
+    argmin-over-cost exactly (backward compatibility bar)."""
+    auto = LeastSquaresEstimator(lam=1e-2)
+    for shape in (TALL_SKINNY, WIDE, ShapeSignature(n=512, d=16, k=4, machines=8)):
+        expected = min(
+            auto.options,
+            key=lambda s: s.cost(
+                shape.n, shape.d, shape.k, shape.sparsity, shape.machines,
+                auto.cpu_weight, auto.mem_weight, auto.network_weight,
+            ),
+        )
+        assert type(auto.choose_solver(shape).chosen) is type(expected)
+
+
+# -- evidence ---------------------------------------------------------------
+
+
+def _seed_spu(store, cls_name, spu):
+    store.store(f"op/{cls_name}", {"spu": spu, "solver_observations": 3})
+
+
+def test_seeded_profiles_flip_borderline_case(tmp_path):
+    """Tall-skinny is borderline between the Gram and TSQR exact solves
+    (~1.2× apart in units). Seeded evidence that the Gram route runs slow
+    per unit (conditioning retries, say) must flip the pick to TSQR —
+    while the un-evidenced iterative solvers stay un-picked."""
+    cost.configure(str(tmp_path))
+    store = cost.get_store()
+    auto = LeastSquaresEstimator(lam=1e-2)
+    assert auto.choose_solver(TALL_SKINNY).label == "LinearMapEstimator"
+    _seed_spu(store, "LinearMapEstimator", 5e-6)
+    _seed_spu(store, "TSQRLeastSquaresEstimator", 1e-6)
+    choice = auto.choose_solver(TALL_SKINNY)
+    assert choice.source == "learned"
+    assert choice.label == "TSQRLeastSquaresEstimator"
+    # predicted seconds exist once evidence is in play
+    assert choice.est_seconds is not None and choice.est_seconds > 0
+
+
+def test_evidence_confirming_the_pick_keeps_it(tmp_path):
+    """One observed run of the chosen solver alone (the natural loop:
+    only the winner gets observed) must NOT flip the choice: unknown
+    classes borrow the known spu scale, preserving the analytic order."""
+    cost.configure(str(tmp_path))
+    store = cost.get_store()
+    _seed_spu(store, "LinearMapEstimator", 2e-6)
+    choice = LeastSquaresEstimator(lam=1e-2).choose_solver(TALL_SKINNY)
+    assert choice.label == "LinearMapEstimator"
+    assert choice.source == "learned"
+
+
+def test_solver_costs_fallback_spu_geometric_mean(tmp_path):
+    store = ProfileStore(str(tmp_path))
+    _seed_spu(store, "LinearMapEstimator", 1e-6)
+    _seed_spu(store, "BlockLeastSquaresEstimator", 4e-6)
+    est = CostEstimator(store)
+    costs = est.solver_costs(
+        LeastSquaresEstimator(lam=1e-2).options, TALL_SKINNY,
+        3.8e-4, 2.9e-1, 1.32,
+    )
+    # unknown classes price at the geometric mean of known spus (2e-6)
+    row = costs["DenseLBFGSwithL2"]
+    assert not row["learned"]
+    assert row["seconds"] == pytest.approx(row["units"] * 2e-6, rel=1e-6)
+
+
+# -- graph-level integration ------------------------------------------------
+
+
+def test_rule_swaps_streaming_solver_for_chunked_leaf():
+    """NodeOptimizationRule must detect the chunked leaf and hand the
+    chooser a chunked shape, so the swapped-in solver can stream."""
+    from keystone_tpu.workflow.executor import GraphExecutor
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((256, 16)).astype(np.float32)
+    Y = rng.standard_normal((256, 4)).astype(np.float32)
+    auto = LeastSquaresEstimator(lam=1e-2)
+    pipe = auto.with_data(ChunkedDataset.from_array(X, 64), Dataset.of(Y))
+    optimized = GraphExecutor(pipe.graph).graph  # triggers the rule stack
+    swapped = [
+        optimized.get_operator(n)
+        for n in optimized.nodes
+        if isinstance(
+            optimized.get_operator(n),
+            (LinearMapEstimator, TSQRLeastSquaresEstimator,
+             BlockLeastSquaresEstimator, DenseLBFGSwithL2),
+        )
+    ]
+    assert swapped, "auto-solver was not swapped"
+    assert all(op.supports_streaming for op in swapped)

@@ -1,0 +1,181 @@
+"""ProfileStore contract: round-trip, corruption tolerance, concurrent
+multi-process updates, environment isolation — the same discipline bar as
+the AOT executable cache (tests/compile/test_cache.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from keystone_tpu.cost.store import ProfileStore, profile_environment
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return ProfileStore(str(tmp_path))
+
+
+def test_round_trip(store):
+    rec = {"spu": 1.25, "seconds_per_item": 3e-6, "solver_observations": 2}
+    store.store("op/LinearMapEstimator", rec)
+    assert store.load("op/LinearMapEstimator") == rec
+    assert store.keys() == ["op/LinearMapEstimator"]
+
+
+def test_miss_returns_none(store):
+    assert store.load("op/Nothing") is None
+
+
+def test_update_read_modify_write(store):
+    store.update("op/X", lambda r: {"n": 1} if r is None else {"n": r["n"] + 1})
+    store.update("op/X", lambda r: {"n": 1} if r is None else {"n": r["n"] + 1})
+    assert store.load("op/X") == {"n": 2}
+
+
+def test_overwrite_replaces(store):
+    store.store("op/X", {"v": 1})
+    store.store("op/X", {"v": 2})
+    assert store.load("op/X") == {"v": 2}
+
+
+def test_distinct_keys_distinct_files(store):
+    store.store("op/A", {"v": 1})
+    store.store("plan/A", {"v": 2})
+    assert store.load("op/A") == {"v": 1}
+    assert store.load("plan/A") == {"v": 2}
+
+
+def test_invalid_key_rejected(store):
+    with pytest.raises(ValueError):
+        store.path("")
+
+
+# -- corruption tolerance ---------------------------------------------------
+
+
+def _path_of(store, key):
+    store.store(key, {"v": 1})
+    return store.path(key)
+
+
+def test_truncated_file_degrades_to_miss(store):
+    path = _path_of(store, "op/T")
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    assert store.load("op/T") is None
+    assert not os.path.exists(path)  # corrupt entries are discarded
+
+
+def test_garbage_file_degrades_to_miss(store):
+    path = _path_of(store, "op/G")
+    with open(path, "wb") as f:
+        f.write(b"\x00\xffnot json at all")
+    assert store.load("op/G") is None
+
+
+def test_checksum_mismatch_degrades_to_miss(store):
+    path = _path_of(store, "op/C")
+    with open(path) as f:
+        doc = json.load(f)
+    doc["record"]["v"] = 999  # doctor the payload, keep the old checksum
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    assert store.load("op/C") is None
+
+
+def test_renamed_foreign_file_degrades_to_miss(store):
+    src = _path_of(store, "op/Src")
+    dst = store.path("op/Dst")
+    os.replace(src, dst)  # embedded key says op/Src
+    assert store.load("op/Dst") is None
+
+
+def test_corrupt_then_rewrite_recovers(store):
+    path = _path_of(store, "op/R")
+    with open(path, "wb") as f:
+        f.write(b"junk")
+    assert store.load("op/R") is None
+    store.store("op/R", {"v": 7})
+    assert store.load("op/R") == {"v": 7}
+
+
+# -- environment isolation --------------------------------------------------
+
+
+def test_env_mismatch_isolated(tmp_path):
+    tpu_like = ProfileStore(
+        str(tmp_path), env={"backend": "tpu", "device_kind": "v5e"}
+    )
+    cpu_like = ProfileStore(
+        str(tmp_path), env={"backend": "cpu", "device_kind": "cpu0"}
+    )
+    tpu_like.store("op/X", {"spu": 9.0})
+    # different env digest => different file => clean miss, no clobber
+    assert cpu_like.load("op/X") is None
+    cpu_like.store("op/X", {"spu": 2.0})
+    assert tpu_like.load("op/X") == {"spu": 9.0}
+    assert cpu_like.load("op/X") == {"spu": 2.0}
+
+
+def test_env_payload_validated_on_handcopied_file(tmp_path):
+    a = ProfileStore(str(tmp_path), env={"backend": "tpu", "device_kind": "a"})
+    b = ProfileStore(str(tmp_path), env={"backend": "cpu", "device_kind": "b"})
+    a.store("op/X", {"spu": 9.0})
+    # simulate an operator copying the file onto the other env's filename
+    os.replace(a.path("op/X"), b.path("op/X"))
+    assert b.load("op/X") is None  # payload env mismatch
+
+
+def test_profile_environment_shape():
+    env = profile_environment()
+    assert set(env) == {"backend", "device_kind"}
+
+
+# -- concurrency ------------------------------------------------------------
+
+
+_WORKER = """
+import sys
+from keystone_tpu.cost.store import ProfileStore
+
+store = ProfileStore(sys.argv[1], env={"backend": "cpu", "device_kind": "t"})
+me = sys.argv[2]
+for i in range(40):
+    store.update(
+        "op/Shared",
+        lambda r: {
+            "count": (0 if r is None else r.get("count", 0)) + 1,
+            "last": me,
+        },
+    )
+    store.store(f"op/Only{me}", {"i": i})
+print("done", me)
+"""
+
+
+def test_two_process_concurrent_update(tmp_path):
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(tmp_path), name],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for name in ("A", "B")
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    store = ProfileStore(str(tmp_path), env={"backend": "cpu", "device_kind": "t"})
+    # the shared record survived the interleaving intact (atomic replace:
+    # last-writer-wins per write, never a torn file)...
+    shared = store.load("op/Shared")
+    assert shared is not None
+    assert shared["last"] in ("A", "B")
+    assert 1 <= shared["count"] <= 80
+    # ...and each process's private records are fully present
+    assert store.load("op/OnlyA") == {"i": 39}
+    assert store.load("op/OnlyB") == {"i": 39}
+    # no stray temp files left behind
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp")]

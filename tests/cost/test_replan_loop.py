@@ -1,0 +1,264 @@
+"""The closed loop end-to-end: a traced fit writes evidence, the second
+fit of the same pipeline plans from it with ZERO sampling executions and
+reproduces the model; the audit covers solver nodes; per-node calibration
+ratios correct the sampled extrapolation."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import keystone_tpu.cost as cost
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LeastSquaresEstimator
+from keystone_tpu.obs import tracer as tracer_mod
+from keystone_tpu.workflow.autocache import profile_nodes
+from keystone_tpu.workflow.env import PipelineEnv
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator
+from keystone_tpu.workflow.optimizers import AutoCachingOptimizer
+from keystone_tpu.workflow.transformer import FunctionNode, Transformer
+
+rng = np.random.default_rng(7)
+X = rng.standard_normal((384, 12)).astype(np.float32)
+Y = rng.standard_normal((384, 3)).astype(np.float32)
+R = rng.standard_normal((12, 12)).astype(np.float32)
+
+
+def _build_pipeline():
+    # fresh instances per run: identity-keyed prefixes must not let the
+    # fit-once state table short-circuit the second fit
+    feat = FunctionNode(batch_fn=lambda A: jnp.tanh(jnp.asarray(A) @ R),
+                        label="feat")
+    auto = LeastSquaresEstimator(lam=1e-2)
+    return feat.and_then(auto, Dataset.of(X), Dataset.of(Y))
+
+
+def _fit_and_apply():
+    cost.reset_sampling()
+    fitted = _build_pipeline().fit()
+    out = np.asarray(fitted.apply(Dataset.of(X[:16])).to_array())
+    return out, cost.sampling_executions()["total"]
+
+
+def test_second_fit_plans_from_evidence_with_zero_sampling(tmp_path):
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    cost.configure(str(tmp_path))
+    out1, sampled1 = _fit_and_apply()
+    assert sampled1 > 0  # the cold run pays sampling
+    out2, sampled2 = _fit_and_apply()
+    assert sampled2 == 0  # the warm run plans entirely from the store
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
+    keys = cost.get_store().keys()
+    assert any(k.startswith("op/") for k in keys)
+    assert any(k.startswith("solver/") for k in keys)
+    assert any(k.startswith("plan/") for k in keys)
+
+
+def test_plan_record_carries_observed_costs_and_ratios(tmp_path):
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    cost.configure(str(tmp_path))
+    _fit_and_apply()
+    store = cost.get_store()
+    plan_keys = [k for k in store.keys() if k.startswith("plan/")]
+    # one evidence plan for the fit graph, plus sampled plans for any
+    # prefix subgraph optimized at pipeline construction
+    assert plan_keys
+    recs = [store.load(k) for k in plan_keys]
+    rows = [r for rec in recs for r in rec["nodes"].values()]
+    assert rows and all("label" in r and "seconds" in r for r in rows)
+    observed = [r for r in rows if r["observed"]]
+    assert observed, "no node observation made it into the plan records"
+    # the per-node measured sample-to-full ratio is recorded where both
+    # an estimate and an observation exist
+    assert any(
+        isinstance(r.get("ratio"), float) and r["ratio"] > 0 for r in observed
+    )
+    solver_keys = [k for k in store.keys() if k.startswith("solver/")]
+    rec = store.load(solver_keys[0])
+    assert rec["chosen"] in (
+        "LinearMapEstimator", "TSQRLeastSquaresEstimator",
+        "BlockLeastSquaresEstimator", "DenseLBFGSwithL2",
+    )
+    assert rec["shape"]["d"] == 12 and rec["shape"]["k"] == 3
+
+
+def test_traced_fit_emits_cost_spans_and_solver_audit(tmp_path):
+    from keystone_tpu.obs.audit import cache_audit
+
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    cost.configure(str(tmp_path))
+    tracer = tracer_mod.install(tracer_mod.Tracer())
+    try:
+        _fit_and_apply()
+        names = [sp.name for sp in tracer.spans()]
+        assert "cost.estimate" in names
+        assert "cost.replan" in names
+        rows = cache_audit(tracer)
+        solver_rows = [r for r in rows if r["kind"] == "solver"]
+        assert len(solver_rows) == 1
+        (row,) = solver_rows
+        assert row["solver"] == row["label"]
+        assert row["observed"] and row["obs_seconds"] > 0
+        assert row["alternatives"] and len(row["alternatives"]) == 5
+    finally:
+        tracer_mod.reset()
+
+
+def test_second_traced_fit_predicts_solver_seconds(tmp_path):
+    """Run 2 prices the solver from evidence: the audit row carries a
+    real estimate-vs-observed ratio for the solver node."""
+    from keystone_tpu.obs.audit import cache_audit
+
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    cost.configure(str(tmp_path))
+    _fit_and_apply()
+    tracer = tracer_mod.install(tracer_mod.Tracer())
+    try:
+        _fit_and_apply()
+        (row,) = [r for r in cache_audit(tracer) if r["kind"] == "solver"]
+        assert row["source"] == "learned"
+        assert row["solver_est_seconds"] is not None
+        assert row["solver_seconds_ratio"] is not None
+    finally:
+        tracer_mod.reset()
+
+
+def test_changed_pipeline_falls_back_to_sampling(tmp_path):
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    cost.configure(str(tmp_path))
+    _fit_and_apply()
+    cost.reset_sampling()
+    # a structurally different pipeline: extra featurizer stage
+    extra = FunctionNode(batch_fn=lambda A: jnp.asarray(A) * 2.0, label="x2")
+    feat = FunctionNode(batch_fn=lambda A: jnp.tanh(jnp.asarray(A) @ R),
+                        label="feat")
+    auto = LeastSquaresEstimator(lam=1e-2)
+    (extra.and_then(feat).and_then(auto, Dataset.of(X), Dataset.of(Y))).fit()
+    assert cost.sampling_executions()["total"] > 0
+
+
+def test_default_optimizer_solver_record_skips_sampling(tmp_path):
+    """Even without the autocache batch (DefaultOptimizer), the solver
+    shape record alone removes run 2's NodeOptimizationRule sampling."""
+    cost.configure(str(tmp_path))
+    _, sampled1 = _fit_and_apply()
+    assert sampled1 > 0
+    _, sampled2 = _fit_and_apply()
+    assert sampled2 == 0
+
+
+def test_no_store_means_no_files_and_unchanged_behavior(tmp_path):
+    assert cost.get_store() is None
+    out1, sampled1 = _fit_and_apply()
+    assert sampled1 > 0
+    out2, sampled2 = _fit_and_apply()
+    assert sampled2 > 0  # nothing persists without a store
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
+
+
+# -- per-node calibration of the sampled extrapolation ----------------------
+
+
+class _Sleepy(Transformer):
+    def apply_batch(self, data):
+        time.sleep(0.01)
+        return Dataset.of(data)
+
+    def apply(self, x):
+        return x
+
+
+def _sleepy_graph():
+    g = Graph()
+    g, leaf = g.add_node(
+        DatasetOperator(Dataset.of(np.ones((32, 4), np.float32))), []
+    )
+    g, t = g.add_node(_Sleepy(), [leaf])
+    g, sink = g.add_sink(t)
+    return g, t
+
+
+def test_calibration_scales_one_nodes_estimate():
+    g, t = _sleepy_graph()
+    base = profile_nodes(g, full_size=32)
+    scaled = profile_nodes(g, full_size=32, calibration={t: 8.0})
+    # the sleepy node's wall time is ~10ms per pull, stable enough that an
+    # 8x calibrated estimate clears 3x the uncalibrated one despite noise
+    assert scaled[t].ns > 3.0 * base[t].ns
+
+
+def test_calibration_ratio_is_clamped():
+    g, t = _sleepy_graph()
+    lo = profile_nodes(g, full_size=32, calibration={t: 1e-12})
+    base = profile_nodes(g, full_size=32)
+    # 1/64 clamp: a corrupt near-zero ratio cannot erase a node's cost
+    assert lo[t].ns > base[t].ns / 200.0
+
+
+def test_observed_by_node_windows_out_prior_fits():
+    """A long-lived process tracer holds every fit's spans and NodeIds are
+    small per-graph ints — the finalize join must see only the current
+    fit's window (replan.PendingPlan.span_watermark), or a second fit of
+    the same pipeline folds doubled seconds into the stored evidence."""
+    from keystone_tpu.obs.audit import observed_by_node
+
+    tracer = tracer_mod.Tracer()
+    with tracer.span("node", node_id="3", op_type="Op"):
+        time.sleep(0.01)
+    watermark = len(tracer.spans())
+    with tracer.span("node", node_id="3", op_type="Op"):
+        time.sleep(0.01)
+
+    merged = observed_by_node(tracer)
+    windowed = observed_by_node(tracer, start=watermark)
+    assert merged["3"]["computes"] == 2
+    assert windowed["3"]["computes"] == 1
+    assert windowed["3"]["seconds"] < merged["3"]["seconds"]
+
+
+def test_repeat_traced_fits_do_not_accumulate_observed_seconds(tmp_path):
+    """Two fits of one pipeline under ONE global tracer: the plan record
+    after fit 2 must hold fit-2-window seconds, not fit1+fit2 sums."""
+    cost.configure(str(tmp_path))
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    tracer = tracer_mod.install(tracer_mod.Tracer())
+    try:
+        _fit_and_apply()
+        fp = [k for k in cost.get_store().keys() if k.startswith("plan/")][0]
+        rec1 = cost.get_store().load(fp)
+        PipelineEnv.get_or_create().reset()
+        PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+        _fit_and_apply()
+        rec2 = cost.get_store().load(fp)
+    finally:
+        tracer_mod.stop()
+    s1 = sum(r["seconds"] for r in rec1["nodes"].values())
+    s2 = sum(r["seconds"] for r in rec2["nodes"].values())
+    # fit 2 is evidence-planned (no sampling) so it can be faster, but an
+    # unwindowed join would sum both fits' spans: >= ~2x fit 1's seconds
+    assert s2 < 1.5 * s1
+
+
+def test_estimate_rows_do_not_inherit_stale_extras_across_passes():
+    """NodeIds are per-graph small ints: after a new optimizer pass (new
+    epoch), a colliding id's row must be replaced wholesale — a plain node
+    in pipeline B must not inherit pipeline A's solver extras in the
+    audit. Within one pass, extras still merge (chooser records kind=
+    "solver" first, the cache planner re-records base fields after)."""
+    tracer = tracer_mod.Tracer()
+    tracer.begin_plan_epoch()
+    tracer.record_node_estimate(
+        "3", "auto-solver", kind="solver", solver="TSQRLeastSquares",
+    )
+    tracer.record_node_estimate("3", "auto-solver", est_seconds=0.5)
+    row = tracer.estimates["3"]
+    assert row["kind"] == "solver" and row["est_seconds"] == 0.5
+
+    tracer.begin_plan_epoch()
+    tracer.record_node_estimate("3", "plain-feat", est_seconds=0.1)
+    row = tracer.estimates["3"]
+    assert row["label"] == "plain-feat"
+    assert "kind" not in row and "solver" not in row
+    assert "_epoch" not in row
