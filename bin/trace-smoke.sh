@@ -22,6 +22,11 @@
 # + cache-plan pricing) and `cost.replan` (trace-informed re-plan) on the
 # cold run, and an evidence-planned (`source: profiles`) cost.estimate on
 # the warm run.
+# A seventh stage runs two λ-grid sweeps (a Gram family and an ungrouped BCD
+# family), an incremental refit, and a hot swap under continuous load, and
+# asserts the `sweep.*` spans (one grid_solve for the shared Gram group),
+# prefix memo-hit events for members 2..G, the `pipeline.absorb` span, and a
+# `serve.swap` span with zero dropped in-flight requests.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
@@ -286,3 +291,116 @@ print(f"COST SPANS OK ({mode}): {len(est)} cost.estimate, "
       f"{len(rep)} cost.replan, sampling={sampled}")
 PY
 done
+
+# -- sweep + incremental-refit + hot-swap spans -------------------------------
+sweep_out="$(mktemp /tmp/keystone-sweep-trace-XXXXXX.json)"
+env JAX_PLATFORMS=cpu KEYSTONE_TRACE="$sweep_out" python - "$sweep_out" <<'PY'
+import json
+import sys
+import time as _t
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from keystone_tpu.utils.obs import configure, export_trace
+
+configure()
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LinearMapEstimator
+from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+from keystone_tpu.serving import ServingEngine
+from keystone_tpu.sweep import GridSweep
+from keystone_tpu.workflow.transformer import FunctionNode
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((512, 32)).astype(np.float32) + 0.5
+Y = (np.tanh(X) @ rng.standard_normal((32, 4))).astype(np.float32)
+LAMS = [1e-2, 1e-1, 1.0]
+prefix = FunctionNode(
+    batch_fn=lambda A: jnp.tanh(A) * 2.0, label="feat"
+).to_pipeline()
+
+# Gram-family sweep: one shared accumulation pass, G solves
+res = GridSweep(
+    prefix, lambda lam: LinearMapEstimator(lam=lam), {"lam": LAMS},
+    Dataset.of(X), Dataset.of(Y),
+).fit()
+
+# ungrouped (cold BCD) sweep: members 2..G memo-hit the shared prefix
+GridSweep(
+    prefix, lambda lam: BlockLeastSquaresEstimator(8, num_iter=1, lam=lam),
+    {"lam": LAMS}, Dataset.of(X), Dataset.of(Y),
+).fit()
+
+# incremental refit, then hot-swap under continuous load
+fitted = res.fitted_for(lam=1e-1)
+Xn = rng.standard_normal((96, 32)).astype(np.float32) + 0.5
+Yn = (np.tanh(Xn) @ rng.standard_normal((32, 4))).astype(np.float32)
+updated = fitted.absorb(Dataset.of(Xn), Dataset.of(Yn))
+
+engine = ServingEngine(
+    fitted, buckets=(8,), datum_shape=(32,), max_wait_ms=1.0
+)
+with engine:
+    stop = [False]
+
+    def hammer():
+        n = 0
+        while not stop[0]:
+            engine.predict(X[n % 64], timeout=30.0)
+            n += 1
+        return n
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futs = [pool.submit(hammer) for _ in range(2)]
+        _t.sleep(0.1)
+        engine.swap(updated)
+        _t.sleep(0.1)
+        stop[0] = True
+        served = sum(f.result(timeout=30) for f in futs)
+    snap = engine.metrics.snapshot()
+
+# zero dropped in-flight requests across the swap
+c = snap["counters"]
+assert served > 0 and c["completed"] == c["submitted"], c
+assert c.get("failed", 0) == 0 and c.get("rejected", 0) == 0, c
+assert c["swaps"] == 1, c
+
+path = export_trace()
+assert path == sys.argv[1], (path, sys.argv[1])
+with open(path) as f:
+    doc = json.load(f)
+ev = doc["traceEvents"]
+
+def spans(name):
+    return [e for e in ev if e["name"] == name]
+
+assert len(spans("sweep.fit")) == 2, "one sweep.fit root per sweep"
+assert len(spans("sweep.plan")) == 2
+assert len(spans("sweep.member")) == 2 * len(LAMS)
+solves = spans("sweep.grid_solve")
+assert len(solves) == 1, "one shared Gram solve group"
+assert solves[0]["args"]["family"] == "gram_ne", solves[0]
+assert int(solves[0]["args"]["members"]) == len(LAMS), solves[0]
+# members 2..G of the ungrouped sweep memo-hit the shared prefix
+hits = [
+    e for e in ev
+    if e.get("ph") == "i" and e["name"] == "node.feat"
+    and e.get("args", {}).get("cache") == "hit"
+]
+assert len(hits) >= len(LAMS) - 1, f"{len(hits)} prefix cache hits"
+absorbs = spans("pipeline.absorb")
+assert len(absorbs) == 1
+assert int(absorbs[0]["args"]["absorbed_rows"]) == 96, absorbs[0]
+swaps = spans("serve.swap")
+assert len(swaps) == 1
+assert int(swaps[0]["args"]["buckets_warmed"]) >= 1, swaps[0]
+print(
+    f"SWEEP/SWAP SPANS OK: {len(solves)} grid_solve, "
+    f"{len(hits)} prefix cache hit(s), absorb+swap spans present, "
+    f"{served} request(s) served across the swap with zero failures"
+)
+PY

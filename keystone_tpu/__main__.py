@@ -137,24 +137,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m keystone_tpu",
         description="Run a pipeline (parity: bin/run-pipeline.sh).",
     )
-    # Pre-scan for --serve-demo: in demo mode there is no pipeline
+    # Pre-scan for the demo modes: in demo mode there is no pipeline
     # positional, and the demo's own flags (--requests 64, ...) must pass
     # through parse_known_args without a positional slot swallowing their
     # values. Accept the same unambiguous prefix abbreviations argparse
-    # would (--serve, --serve-d, ...; no other option starts with --s).
+    # would (--serve, --sweep-d, ...); a prefix of BOTH demo flags
+    # (--s, --sw is fine, --s is not) matches neither and falls through
+    # to argparse's ambiguity error.
+    def _is_demo_flag(a: str, flag: str, other: str) -> bool:
+        return (
+            len(a) > 2 and flag.startswith(a) and not other.startswith(a)
+        )
+
     def _is_serve_demo_flag(a: str) -> bool:
-        return a.startswith("--s") and "--serve-demo".startswith(a)
+        return _is_demo_flag(a, "--serve-demo", "--sweep-demo")
+
+    def _is_sweep_demo_flag(a: str) -> bool:
+        return _is_demo_flag(a, "--sweep-demo", "--serve-demo")
 
     serve_demo = any(_is_serve_demo_flag(a) for a in argv)
-    argv = [a for a in argv if not _is_serve_demo_flag(a)]
-    # registered for -h only; the flag itself is consumed by the pre-scan
+    sweep_demo = any(_is_sweep_demo_flag(a) for a in argv)
+    argv = [
+        a for a in argv
+        if not (_is_serve_demo_flag(a) or _is_sweep_demo_flag(a))
+    ]
+    # registered for -h only; the flags themselves are consumed above
     p.add_argument(
         "--serve-demo", action="store_true", dest="serve_demo",
         help="smoke mode: fit a small pipeline and push synthetic traffic "
              "through the serving engine (see keystone_tpu/serving/); "
              "replaces the pipeline name",
     )
-    if not serve_demo:
+    p.add_argument(
+        "--sweep-demo", action="store_true", dest="sweep_demo",
+        help="smoke mode: fit a λ grid as ONE merged DAG "
+             "(keystone_tpu/sweep/), absorb appended chunks into the best "
+             "member, and hot-swap it into a live serving engine; "
+             "replaces the pipeline name",
+    )
+    if not (serve_demo or sweep_demo):
         # validated by _resolve_pipeline, not choices=, so shorthand
         # aliases (mnist, cifar, ...) and any-case names resolve
         p.add_argument(
@@ -203,7 +224,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              "(also: KEYSTONE_PROFILE_DIR=DIR)",
     )
     args, rest = p.parse_known_args(argv)
-    if not serve_demo:
+    if not (serve_demo or sweep_demo):
         name = _resolve_pipeline(p, args.pipeline)
     from .utils.obs import configure, export_trace
 
@@ -217,6 +238,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             from .serving.demo import main as serve_demo_main
 
             return serve_demo_main(rest)
+        if sweep_demo:
+            from .sweep.demo import main as sweep_demo_main
+
+            return sweep_demo_main(rest)
         return PIPELINES[name](rest)
     finally:
         # no-op unless --trace/KEYSTONE_TRACE configured tracing; writing

@@ -16,8 +16,13 @@ from .bcd import (
     stream_column_means,
 )
 from .tsqr import tsqr_r, tsqr_r_streaming
+from .accumulators import GramSolverState, MomentsState, TsqrRState
+from .weighted import solve_weighted_streaming
 
 __all__ = [
+    "GramSolverState",
+    "MomentsState",
+    "TsqrRState",
     "RowShardedMatrix",
     "gram",
     "cross",
@@ -29,6 +34,7 @@ __all__ = [
     "solve_blockwise_l2",
     "solve_blockwise_l2_scan",
     "solve_blockwise_l2_streaming",
+    "solve_weighted_streaming",
     "stream_column_means",
     "tsqr_r",
     "tsqr_r_streaming",

@@ -1,0 +1,325 @@
+"""Snapshot-able streaming accumulators — the state behind incremental refit.
+
+The streaming solvers fold row chunks into small sufficient statistics (a
+Gram matrix, a TSQR R factor, column sums, Chan/Welford moment triples) and
+then solve once. Those statistics are *associative over row blocks*, which
+makes them reusable in two ways the one-shot fit never exploited:
+
+* **λ grids** — one accumulation pass prices every λ: the regularizer only
+  enters at the solve, so ``GramSolverState.solve(lam)`` is O(d³) per grid
+  member against one shared O(n·d²) pass (``keystone_tpu/sweep/``).
+* **appended data** — ``update()`` folds new chunks into a saved state and
+  ``solve()`` re-derives the model from O(new chunks) work instead of a
+  from-scratch refit (``FittedPipeline.absorb``).
+
+Centering is algebraic, not positional: the accumulators keep RAW sums
+(ΣAᵀA, ΣAᵀy, Σa, Σy, n) and derive the centered Gram/cross at solve time
+(Σ(a−μ)(a−μ)ᵀ = ΣAᵀA − n·μμᵀ), so the column means may keep moving as
+chunks arrive — the property positional two-pass centering cannot have.
+State is held as host numpy so snapshots pickle with the fitted model and
+content-fingerprint deterministically — and in FLOAT64: the raw sums grow
+to n·μ² while the centered Gram is only n·σ², so the solve-time
+subtraction catastrophically cancels in f32 for large-n offset-mean data
+(TPUs have no device f64, hence host accumulation; same policy as
+:class:`MomentsState`). Per-chunk products run on device in f32 against
+a PROVISIONAL SHIFT (the first chunk's column means — the f32-safe trick:
+Σ(a−μ)(a−μ)ᵀ = Σ(a−s)(a−s)ᵀ − n(μ−s)(μ−s)ᵀ for any s, and s near μ
+removes the μ² mass from the products before they ever round), and only
+the chunk-LOCAL result crosses to host (no per-chunk upload of the
+running state).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+
+def _np(x) -> np.ndarray:
+    """Host copy of a device or host array (one fetch, no dtype change)."""
+    return np.asarray(x)
+
+
+@dataclass
+class GramSolverState:
+    """Raw normal-equations sufficient statistics: the exact-solve
+    accumulator of :mod:`~keystone_tpu.linalg.normal_equations`, made
+    restartable. All arrays are float64 numpy on host (see the module
+    docstring: the algebraic centering cancels in f32)."""
+
+    n: int = 0
+    sum_x: Optional[np.ndarray] = None  # (d,)   Σ a
+    sum_y: Optional[np.ndarray] = None  # (k,)   Σ y
+    gram: Optional[np.ndarray] = None   # (d, d) Σ (a−s)ᵀ(a−s)
+    cross: Optional[np.ndarray] = None  # (d, k) Σ (a−s)ᵀ(y−s_y)
+    #: provisional shifts (first chunk's column means, f32) the device
+    #: products are taken against; the exact means enter at solve time
+    shift: Optional[np.ndarray] = None    # (d,)
+    shift_y: Optional[np.ndarray] = None  # (k,)
+    #: the ridge parameter the owning model was solved with — what
+    #: ``FittedPipeline.absorb`` re-solves at
+    lam: float = 0.0
+    #: rows folded since construction OR the last snapshot() — the
+    #: O(new chunks) work gate reads this, not ``n``
+    rows_folded: int = field(default=0, compare=False)
+
+    @property
+    def d(self) -> int:
+        return 0 if self.gram is None else int(self.gram.shape[0])
+
+    @property
+    def k(self) -> int:
+        return 0 if self.cross is None else int(self.cross.shape[1])
+
+    def update(self, A_chunk, y_chunk) -> "GramSolverState":
+        """Fold one (rows, d) feature chunk and its (rows, k) label slice.
+        Runs the Gram contraction on device (one chunk-LOCAL
+        ``gram_accumulate`` program — the same f32-true GEMMs the
+        streaming solver uses, from zero accumulators so the running
+        state never uploads) and adds the result into the host float64
+        totals."""
+        import jax.numpy as jnp
+
+        from .normal_equations import gram_accumulate
+
+        A = jnp.asarray(A_chunk, dtype=jnp.float32)
+        y = jnp.asarray(y_chunk, dtype=jnp.float32)
+        if A.ndim != 2 or y.ndim != 2:
+            raise ValueError(
+                f"chunks must be 2-D (A: {A.shape}, y: {y.shape})"
+            )
+        if A.shape[0] != y.shape[0]:
+            raise ValueError(
+                f"feature chunk has {A.shape[0]} rows, labels {y.shape[0]}"
+            )
+        rows, d = int(A.shape[0]), int(A.shape[1])
+        k = int(y.shape[1])
+        if self.gram is None:
+            self.sum_x = np.zeros((d,), np.float64)
+            self.sum_y = np.zeros((k,), np.float64)
+            self.gram = np.zeros((d, d), np.float64)
+            self.cross = np.zeros((d, k), np.float64)
+            self.shift = _np(jnp.mean(A, axis=0)).astype(np.float32)
+            self.shift_y = _np(jnp.mean(y, axis=0)).astype(np.float32)
+        elif d != self.d or k != self.k:
+            raise ValueError(
+                f"chunk shape ({d}, {k}) does not match accumulated "
+                f"({self.d}, {self.k})"
+            )
+        G, C = gram_accumulate(
+            jnp.zeros((d, d), jnp.float32), jnp.zeros((d, k), jnp.float32),
+            A - jnp.asarray(self.shift), y - jnp.asarray(self.shift_y),
+        )
+        self.gram += _np(G).astype(np.float64)
+        self.cross += _np(C).astype(np.float64)
+        self.sum_x += _np(jnp.sum(A, axis=0)).astype(np.float64)
+        self.sum_y += _np(jnp.sum(y, axis=0)).astype(np.float64)
+        self.n += rows
+        self.rows_folded += rows
+        return self
+
+    def update_chunks(self, pairs: Iterable[Tuple]) -> "GramSolverState":
+        for A_chunk, y_chunk in pairs:
+            self.update(A_chunk, y_chunk)
+        return self
+
+    def solve(self, lam: float = 0.0):
+        """(W, intercept, feature_mean) for ridge parameter ``lam`` from
+        the CURRENT accumulated state — O(d³), no data pass. Centered
+        algebraically IN FLOAT64 (Gc = ΣAᵀA − n·μμᵀ, Cc = ΣAᵀy − n·μνᵀ;
+        the cancellation happens here), then downcast for the device
+        solve."""
+        import jax.numpy as jnp
+
+        from .row_matrix import solve_spd
+
+        if self.gram is None or self.n == 0:
+            raise ValueError("solve of an empty GramSolverState")
+        n = float(self.n)
+        mu = self.sum_x / n
+        nu = self.sum_y / n
+        # the products were taken against the provisional shift s, so the
+        # correction is in (μ−s) — tiny when s tracked the data
+        dmu = mu - self.shift.astype(np.float64)
+        dnu = nu - self.shift_y.astype(np.float64)
+        Gc = self.gram - n * np.outer(dmu, dmu)
+        Cc = self.cross - n * np.outer(dmu, dnu)
+        W = solve_spd(
+            jnp.asarray(Gc, dtype=jnp.float32),
+            jnp.asarray(Cc, dtype=jnp.float32),
+            jnp.float32(lam),
+        )
+        return (
+            W,
+            jnp.asarray(nu, dtype=jnp.float32),
+            jnp.asarray(mu, dtype=jnp.float32),
+        )
+
+    def snapshot(self) -> "GramSolverState":
+        """An independent copy with the ``rows_folded`` work counter
+        zeroed — what a fitted model carries so a later ``absorb`` can
+        fold new chunks without disturbing the original."""
+        return GramSolverState(
+            n=self.n,
+            sum_x=None if self.sum_x is None else self.sum_x.copy(),
+            sum_y=None if self.sum_y is None else self.sum_y.copy(),
+            gram=None if self.gram is None else self.gram.copy(),
+            cross=None if self.cross is None else self.cross.copy(),
+            shift=None if self.shift is None else self.shift.copy(),
+            shift_y=None if self.shift_y is None else self.shift_y.copy(),
+            lam=self.lam,
+            rows_folded=0,
+        )
+
+    def merge(self, other: "GramSolverState") -> "GramSolverState":
+        """Associative combine (e.g. per-lane partial states). The two
+        sides' products may be against different provisional shifts;
+        ``other``'s are translated to this state's shift exactly (f64):
+        with δ = s₂−s₁, Σ(a−s₂)(a−s₂)ᵀ = Σ(a−s₁)(a−s₁)ᵀ − Σ(a−s₁)δᵀ
+        − δΣ(a−s₁)ᵀ + nδδᵀ and Σ(a−s₁) = Σa − n·s₁."""
+        if other.gram is None:
+            return self
+        if self.gram is None:
+            # in-place like the non-empty path (and MomentsState.merge):
+            # adopt other's shift so no translation is needed, and count
+            # its rows as folded-through-this-state work
+            self.n = other.n
+            self.rows_folded += other.n
+            self.sum_x = other.sum_x.copy()
+            self.sum_y = other.sum_y.copy()
+            self.gram = other.gram.copy()
+            self.cross = other.cross.copy()
+            self.shift = other.shift.copy()
+            self.shift_y = other.shift_y.copy()
+            return self
+        if (self.d, self.k) != (other.d, other.k):
+            raise ValueError("merging mismatched GramSolverStates")
+        on = float(other.n)
+        s1 = other.shift.astype(np.float64)
+        sy1 = other.shift_y.astype(np.float64)
+        delta = s1 - self.shift.astype(np.float64)       # s₁ − s₂ = −δ
+        delta_y = sy1 - self.shift_y.astype(np.float64)
+        cx = other.sum_x - on * s1   # Σ(a−s₁) over other's rows
+        cy = other.sum_y - on * sy1  # Σ(y−s_y₁)
+        gram2 = (
+            other.gram
+            + np.outer(cx, delta) + np.outer(delta, cx)
+            + on * np.outer(delta, delta)
+        )
+        cross2 = (
+            other.cross
+            + np.outer(cx, delta_y) + np.outer(delta, cy)
+            + on * np.outer(delta, delta_y)
+        )
+        self.n += other.n
+        self.rows_folded += other.n
+        self.sum_x = self.sum_x + other.sum_x
+        self.sum_y = self.sum_y + other.sum_y
+        self.gram = self.gram + gram2
+        self.cross = self.cross + cross2
+        return self
+
+
+@dataclass
+class TsqrRState:
+    """The streaming-TSQR accumulator (``qr([R; chunk])`` fold) as a
+    snapshot: restarting the fold from a saved R is exactly resuming the
+    sequential TSQR recurrence, so appended chunks cost one small QR each
+    instead of a re-factorization of the full history."""
+
+    r: Optional[np.ndarray] = None  # (w, w) upper-triangular
+    n: int = 0
+
+    def update(self, chunk) -> "TsqrRState":
+        import jax.numpy as jnp
+
+        from .tsqr import _qr_fold, _qr_r
+
+        chunk = jnp.asarray(chunk, dtype=jnp.float32)
+        if chunk.ndim != 2:
+            raise ValueError(f"chunks must be 2-D, got {chunk.shape}")
+        if self.r is None:
+            self.r = _np(_qr_r(chunk))
+        else:
+            if int(chunk.shape[1]) != int(self.r.shape[1]):
+                raise ValueError(
+                    f"chunk width {chunk.shape[1]} does not match "
+                    f"accumulated width {self.r.shape[1]}"
+                )
+            self.r = _np(_qr_fold(jnp.asarray(self.r), chunk))
+        self.n += int(chunk.shape[0])
+        return self
+
+    def finalize(self):
+        """The sign-fixed R factor of everything folded so far."""
+        import jax.numpy as jnp
+
+        from .tsqr import _fix_sign
+
+        if self.r is None:
+            raise ValueError("finalize of an empty TsqrRState")
+        return _fix_sign(jnp.asarray(self.r))
+
+    def snapshot(self) -> "TsqrRState":
+        return TsqrRState(
+            r=None if self.r is None else self.r.copy(), n=self.n
+        )
+
+
+@dataclass
+class MomentsState:
+    """Chan/Welford column-moment accumulator (count, mean, M2) — the
+    StandardScaler's streaming statistic, snapshot-able so scaler moments
+    can fold appended chunks with the same merge the laned scan uses."""
+
+    n: int = 0
+    mean: Optional[np.ndarray] = None  # (d,)
+    m2: Optional[np.ndarray] = None    # (d,) Σ (a − mean)²
+
+    def update(self, chunk) -> "MomentsState":
+        chunk = _np(chunk).astype(np.float64)
+        if chunk.ndim != 2:
+            raise ValueError(f"chunks must be 2-D, got {chunk.shape}")
+        rows = int(chunk.shape[0])
+        if rows == 0:
+            return self
+        c_mean = chunk.mean(axis=0)
+        c_m2 = ((chunk - c_mean) ** 2).sum(axis=0)
+        if self.mean is None:
+            self.n, self.mean, self.m2 = rows, c_mean, c_m2
+            return self
+        # Chan et al. pairwise merge
+        delta = c_mean - self.mean
+        total = self.n + rows
+        self.mean = self.mean + delta * (rows / total)
+        self.m2 = self.m2 + c_m2 + delta * delta * (self.n * rows / total)
+        self.n = total
+        return self
+
+    def merge(self, other: "MomentsState") -> "MomentsState":
+        if other.mean is None:
+            return self
+        if self.mean is None:
+            self.n, self.mean, self.m2 = other.n, other.mean.copy(), other.m2.copy()
+            return self
+        delta = other.mean - self.mean
+        total = self.n + other.n
+        self.mean = self.mean + delta * (other.n / total)
+        self.m2 = self.m2 + other.m2 + delta * delta * (self.n * other.n / total)
+        self.n = total
+        return self
+
+    def std(self, ddof: int = 0) -> np.ndarray:
+        if self.mean is None:
+            raise ValueError("std of an empty MomentsState")
+        denom = max(self.n - ddof, 1)
+        return np.sqrt(self.m2 / denom)
+
+    def snapshot(self) -> "MomentsState":
+        return MomentsState(
+            n=self.n,
+            mean=None if self.mean is None else self.mean.copy(),
+            m2=None if self.m2 is None else self.m2.copy(),
+        )

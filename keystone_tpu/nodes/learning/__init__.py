@@ -9,8 +9,10 @@ from .classifiers import (
 )
 from .kernel import (
     BlockKernelMatrix,
+    ExactKernelRidge,
     GaussianKernelGenerator,
     KernelBlockLinearMapper,
+    KernelRidgeEstimator,
     KernelRidgeRegression,
 )
 from .lbfgs import (
@@ -22,6 +24,7 @@ from .weighted import (
     BlockWeightedLeastSquaresEstimator,
     PerClassWeightedLeastSquaresEstimator,
     ReWeightedLeastSquaresEstimator,
+    WeightedLeastSquaresEstimator,
 )
 from .gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from .kmeans import KMeansModel, KMeansPlusPlusEstimator
@@ -54,8 +57,10 @@ __all__ = [
     "NaiveBayesEstimator",
     "NaiveBayesModel",
     "BlockKernelMatrix",
+    "ExactKernelRidge",
     "GaussianKernelGenerator",
     "KernelBlockLinearMapper",
+    "KernelRidgeEstimator",
     "KernelRidgeRegression",
     "DenseLBFGSwithL2",
     "LocalLeastSquaresEstimator",
@@ -63,6 +68,7 @@ __all__ = [
     "BlockWeightedLeastSquaresEstimator",
     "PerClassWeightedLeastSquaresEstimator",
     "ReWeightedLeastSquaresEstimator",
+    "WeightedLeastSquaresEstimator",
     "GaussianMixtureModel",
     "GaussianMixtureModelEstimator",
     "KMeansModel",

@@ -20,7 +20,7 @@ from ...parallel.mesh import default_mesh, shard_batch
 from ...workflow.node_optimization import Optimizable
 from ...workflow.transformer import LabelEstimator, Transformer
 from ...utils.params import as_param
-from .cost import CostModel
+from .cost import AutoSolverFrontDoor, CostModel
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, minimize_lbfgs
 from .linear import (
     BlockLeastSquaresEstimator,
@@ -245,7 +245,9 @@ class LinearDiscriminantAnalysis(LabelEstimator):
         return LinearMapper(jnp.asarray(W, dtype=jnp.float32))
 
 
-class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
+class LeastSquaresEstimator(
+    LabelEstimator, AutoSolverFrontDoor, CostModel, Optimizable
+):
     """Cost-model auto-selecting least squares solver
     (parity: LeastSquaresEstimator.scala:26-88; option set preserved —
     dense LBFGS, sparse LBFGS, block solver (1000, 3), exact normal
@@ -276,10 +278,6 @@ class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
             TSQRLeastSquaresEstimator(lam=lam),
         ]
         self.default = self.options[0]
-
-    @property
-    def weight(self) -> int:
-        return self.default.weight
 
     def sample_optimize(self, samples, num_items: int, chunked: bool = False):
         """Graph-level entry: pick the concrete solver from dependency
@@ -321,18 +319,6 @@ class LeastSquaresEstimator(LabelEstimator, CostModel, Optimizable):
             n=int(n), d=int(d), k=int(k), sparsity=float(sparsity),
             chunked=bool(chunked),
             machines=int(self.num_machines or default_mesh().size),
-        )
-
-    def choose_solver(self, shape, node_id: Optional[str] = None):
-        """Run the cost-model chooser over the option set; returns the
-        full :class:`~keystone_tpu.cost.SolverChoice` (pricing table
-        included) for the given shape signature."""
-        from ...cost import SolverChooser
-
-        return SolverChooser().choose(
-            self.options, shape,
-            self.cpu_weight, self.mem_weight, self.network_weight,
-            node_id=node_id, owner_label=type(self).__name__,
         )
 
     def optimize(self, sample: Dataset, sample_labels: Dataset,

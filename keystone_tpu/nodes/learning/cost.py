@@ -61,3 +61,90 @@ def combine_cost(
 DEFAULT_CPU_WEIGHT = 2.5e-12
 DEFAULT_MEM_WEIGHT = 1.2e-9
 DEFAULT_NETWORK_WEIGHT = 2.2e-11
+
+
+def dense_shape_from_samples(samples, num_items: int, machines: int,
+                             chunked: bool = False):
+    """Distill (data, labels) dependency samples into the chooser's
+    :class:`~keystone_tpu.cost.ShapeSignature` for a dense solve — the
+    shared front half of every auto-solver's ``shape_from_samples`` (n is
+    the FULL dataset size, d/k peeked from one sample item). Sparse-aware
+    families (``LeastSquaresEstimator``) keep their own richer version."""
+    import numpy as np
+
+    from ...cost import ShapeSignature
+    from ...data.dataset import Dataset
+
+    sample = Dataset.of(samples[0])
+    sample_labels = Dataset.of(samples[1])
+    d = int(np.asarray(sample.first()).shape[-1])
+    k = int(np.asarray(sample_labels.first()).shape[-1])
+    n = num_items if num_items else len(sample)
+    return ShapeSignature(
+        n=int(n), d=d, k=k, chunked=bool(chunked), machines=int(machines)
+    )
+
+
+class AutoSolverFrontDoor:
+    """The cost-model front-door protocol shared by the auto-selecting
+    estimator families (``LeastSquaresEstimator``,
+    ``WeightedLeastSquaresEstimator``, ``KernelRidgeEstimator``): an
+    ``options`` list of interchangeable physical solvers, selection
+    through :class:`keystone_tpu.cost.SolverChooser`, and the
+    graph-level ``sample_optimize`` hook.
+
+    Subclass ``__init__`` must set ``self.options``, ``self.default``,
+    ``self.num_machines``, and call :meth:`_init_chooser_weights`.
+    ``shape_from_samples`` defaults to the dense signature; sparse-aware
+    families override it. ``cost`` prices the front door as its cheapest
+    option, so an un-resolved auto node ranks where its best member
+    would."""
+
+    def _init_chooser_weights(self, cpu_weight, mem_weight, network_weight):
+        self.cpu_weight = (
+            DEFAULT_CPU_WEIGHT if cpu_weight is None else cpu_weight
+        )
+        self.mem_weight = (
+            DEFAULT_MEM_WEIGHT if mem_weight is None else mem_weight
+        )
+        self.network_weight = (
+            DEFAULT_NETWORK_WEIGHT if network_weight is None
+            else network_weight
+        )
+
+    @property
+    def weight(self) -> int:
+        return self.default.weight
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        return min(
+            opt.cost(n, d, k, sparsity, num_machines,
+                     cpu_weight, mem_weight, network_weight)
+            for opt in self.options
+        )
+
+    def shape_from_samples(self, samples, num_items: int,
+                           chunked: bool = False):
+        from ...parallel.mesh import default_mesh
+
+        return dense_shape_from_samples(
+            samples, num_items,
+            self.num_machines or default_mesh().size, chunked,
+        )
+
+    def choose_solver(self, shape, node_id=None):
+        """Run the cost-model chooser over the option set; returns the
+        full :class:`~keystone_tpu.cost.SolverChoice` (pricing table
+        included) for the given shape signature."""
+        from ...cost import SolverChooser
+
+        return SolverChooser().choose(
+            self.options, shape,
+            self.cpu_weight, self.mem_weight, self.network_weight,
+            node_id=node_id, owner_label=type(self).__name__,
+        )
+
+    def sample_optimize(self, samples, num_items: int, chunked: bool = False):
+        shape = self.shape_from_samples(samples, num_items, chunked=chunked)
+        return self.choose_solver(shape).chosen

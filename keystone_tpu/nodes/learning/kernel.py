@@ -27,6 +27,8 @@ from ...linalg.row_matrix import solve_spd
 from ...utils.timing import phase
 from ...utils.jit import nestable_jit
 from ...workflow.transformer import LabelEstimator, Transformer
+from ...workflow.node_optimization import Optimizable
+from .cost import AutoSolverFrontDoor, CostModel, combine_cost
 
 
 @nestable_jit
@@ -173,7 +175,7 @@ def _kernel_block_slice(X, start, gamma, bs):
     return _gaussian_block(X, Xb, gamma)
 
 
-class KernelRidgeRegression(LabelEstimator):
+class KernelRidgeRegression(LabelEstimator, CostModel):
     """Gauss-Seidel block-coordinate kernel ridge regression
     (parity: KernelRidgeRegression.scala:37-235). Per block B:
         (K_BB + λI) W_B ← y_B − (K_Bᵀ W − K_BBᵀ W_B_old)
@@ -206,6 +208,29 @@ class KernelRidgeRegression(LabelEstimator):
 
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         return os.path.join(self.checkpoint_dir, "krr_state.npz")
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        # kernel generation n²·d once (cached) or per epoch; per epoch
+        # every block pays the n×bs residual GEMM (n²·k total) and a bs³
+        # Cholesky (n·bs² total); cached-kernel epochs re-stream n² floats
+        bs = min(self.block_size, n)
+        gen_epochs = 1 if self.cache_kernel else self.num_epochs
+        return combine_cost(
+            {
+                "flops": (
+                    gen_epochs * float(n) * n * d
+                    + self.num_epochs * (float(n) * n * k + float(n) * bs * bs)
+                ) / num_machines,
+                "bytes": (
+                    self.num_epochs * float(n) * n / num_machines
+                    + float(n) * d
+                ),
+                "network": float(n) * k * self.num_epochs,
+                "passes": self.num_epochs,
+            },
+            cpu_weight, mem_weight, network_weight,
+        )
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
         import os
@@ -279,6 +304,99 @@ class KernelRidgeRegression(LabelEstimator):
         if ckpt and os.path.exists(ckpt):
             os.remove(ckpt)  # complete fit: drop the restart state
         return KernelBlockLinearMapper(X, W, self.gamma, bs)
+
+
+class ExactKernelRidge(LabelEstimator, CostModel):
+    """Direct kernel ridge: materialize K block-by-block and solve
+    (K + λI) W = Y with one Cholesky — exact, one shot, O(n²) memory and
+    an n³/3 factorization. The cheap end of the KRR family when n is
+    small enough that the full kernel fits and the cubic solve beats
+    ``num_epochs`` Gauss-Seidel sweeps; prices out fast as n grows. Same
+    fitted-model contract as the Gauss-Seidel solver
+    (:class:`KernelBlockLinearMapper`), so the two are interchangeable
+    physical implementations behind :class:`KernelRidgeEstimator`."""
+
+    def __init__(self, gamma: float, lam: float, block_size: int):
+        self.gamma = gamma
+        self.lam = lam
+        self.block_size = block_size
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        return combine_cost(
+            {
+                # generation + one Cholesky + the triangular solves
+                "flops": (
+                    float(n) * n * d + float(n) ** 3 / 3.0
+                    + float(n) * n * k
+                ) / num_machines,
+                "bytes": float(n) * n / num_machines + float(n) * d,
+                "network": float(n) * k,
+                "passes": 1,
+            },
+            cpu_weight, mem_weight, network_weight,
+        )
+
+    def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
+        X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+        Y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        n = X.shape[0]
+        bs = self.block_size
+        with phase("krr.exact_solve") as out:
+            cols = [
+                _kernel_block_slice(
+                    X, start, jnp.float32(self.gamma), min(bs, n - start)
+                )
+                for start in range(0, n, bs)
+            ]
+            K = jnp.concatenate(cols, axis=1)  # (n, n)
+            W = solve_spd(K, Y, jnp.float32(self.lam))
+            out.append(W)
+        return KernelBlockLinearMapper(X, W, self.gamma, bs)
+
+
+class KernelRidgeEstimator(
+    LabelEstimator, AutoSolverFrontDoor, CostModel, Optimizable
+):
+    """Cost-model auto-selecting front door for kernel ridge regression:
+    the exact full-kernel solve vs the Gauss-Seidel block solver — both
+    produce a :class:`KernelBlockLinearMapper` for the same (γ, λ), so
+    selection is purely a cost question (the cubic factorization wins at
+    small n, the epoch-bounded block sweeps win once n³ dominates).
+    Runs through :class:`keystone_tpu.cost.SolverChooser`: with a profile
+    store configured the family earns learned ``op/`` seconds-per-unit
+    profiles from traced fits, and borderline shapes are decided by
+    predicted wall-clock instead of analytic units."""
+
+    def __init__(self, gamma: float, lam: float, block_size: int,
+                 num_epochs: int, block_permuter: Optional[int] = None,
+                 cache_kernel: bool = True,
+                 num_machines: Optional[int] = None,
+                 cpu_weight: Optional[float] = None,
+                 mem_weight: Optional[float] = None,
+                 network_weight: Optional[float] = None):
+        self.gamma = gamma
+        self.lam = lam
+        self.block_size = block_size
+        self.num_epochs = num_epochs
+        self.num_machines = num_machines
+        self._init_chooser_weights(cpu_weight, mem_weight, network_weight)
+        self.options: Sequence = [
+            KernelRidgeRegression(
+                gamma, lam, block_size, num_epochs,
+                block_permuter=block_permuter, cache_kernel=cache_kernel,
+            ),
+            ExactKernelRidge(gamma, lam, block_size),
+        ]
+        self.default = self.options[0]
+
+    def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
+        data = Dataset.of(data)
+        labels = Dataset.of(labels)
+        solver = self.sample_optimize(
+            [data.take(24), labels.take(24)], len(data)
+        )
+        return solver.fit(data, labels)
 
 
 class GaussianKernelGenerator(LabelEstimator):

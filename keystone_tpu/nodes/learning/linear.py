@@ -34,10 +34,20 @@ class LinearMapper(Transformer):
     """out = (x − feature_mean) · W + b  (parity: LinearMapper.scala:18-63;
     scaling folded into the single GEMM)."""
 
-    def __init__(self, W, b=None, feature_mean=None):
+    #: ``solver_state`` is refit bookkeeping (a snapshot-able
+    #: GramSolverState), not part of the serve computation — W/b/mean fully
+    #: determine trace_batch, so two mappers differing only in it must
+    #: share AOT executables
+    aot_fingerprint_exclude = ("solver_state",)
+
+    def __init__(self, W, b=None, feature_mean=None, solver_state=None):
         self.W = as_param(W)
         self.b = as_param(b)
         self.feature_mean = as_param(feature_mean)
+        #: optional :class:`~keystone_tpu.linalg.accumulators.GramSolverState`
+        #: captured at fit time — what ``FittedPipeline.absorb`` folds
+        #: appended chunks into (None when the fit didn't snapshot)
+        self.solver_state = solver_state
 
     def trace_batch(self, X):
         if self.feature_mean is not None:
@@ -53,16 +63,77 @@ class LinearMapEstimator(LabelEstimator, CostModel):
     (parity: LinearMapper.scala:69-100). Chunked inputs stream: a means
     pass, then centered (A, y) chunks through the laned Gram accumulator
     (``solve_least_squares_streaming``) — the exact solve never
-    materializes the design matrix."""
+    materializes the design matrix.
+
+    ``snapshot=True`` fits through the raw-accumulator algebra
+    (:class:`~keystone_tpu.linalg.accumulators.GramSolverState`: ΣAᵀA and
+    ΣAᵀy with centering applied algebraically at the solve) and attaches
+    the state to the fitted :class:`LinearMapper` — the handle
+    ``FittedPipeline.absorb`` folds appended chunks into for an
+    O(new chunks) incremental refit."""
 
     supports_streaming = True
 
-    def __init__(self, lam: Optional[float] = None):
+    def __init__(self, lam: Optional[float] = None, snapshot: bool = False):
         self.lam = lam
+        self.snapshot = snapshot
+
+    # -- sweep grid hooks (keystone_tpu/sweep/) -------------------------
+
+    def grid_family(self):
+        """Estimators of one sweep whose key matches fit as a group; λ is
+        the swept axis, so it is excluded from the key."""
+        return ("gram_ne", bool(self.snapshot))
+
+    @staticmethod
+    def fit_lambda_grid(estimators: Sequence["LinearMapEstimator"],
+                        data, labels: Dataset) -> List[LinearMapper]:
+        """Fit a λ-only grid from ONE accumulation pass: the Gram and
+        cross products don't depend on λ, so the grid costs
+        O(prefix + n·d² + G·d³) instead of G full fits. Every returned
+        mapper carries its own snapshot of the shared state (λ recorded),
+        so any of them can later ``absorb`` appended chunks."""
+        from ...data.chunked import ChunkedDataset
+        from ...linalg.accumulators import GramSolverState
+        from ...utils.timing import phase
+
+        state = GramSolverState()
+        with phase("linear_map.grid_accumulate") as out:
+            if isinstance(data, ChunkedDataset):
+                y = jnp.asarray(
+                    Dataset.of(labels).to_array(), dtype=jnp.float32
+                )
+                offset = 0
+                for chunk in data.raw_chunks():
+                    rows = int(chunk.shape[0])
+                    state.update(chunk, y[offset : offset + rows])
+                    offset += rows
+                if offset != y.shape[0]:
+                    raise ValueError(
+                        f"chunked features have {offset} rows, labels "
+                        f"{y.shape[0]}"
+                    )
+            else:
+                state.update(
+                    Dataset.of(data).to_array(),
+                    Dataset.of(labels).to_array(),
+                )
+            out.append(state.gram)
+        models = []
+        for est in estimators:
+            W, b, mean = state.solve(est.lam or 0.0)
+            snap = state.snapshot()
+            snap.lam = float(est.lam or 0.0)
+            models.append(
+                LinearMapper(W, b=b, feature_mean=mean, solver_state=snap)
+            )
+        return models
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         from ...data.chunked import ChunkedDataset
 
+        if self.snapshot:
+            return LinearMapEstimator.fit_lambda_grid([self], data, labels)[0]
         if isinstance(data, ChunkedDataset):
             return self._fit_streaming(data, labels)
         A = shard_batch(data.to_array().astype(jnp.float32))
@@ -179,12 +250,53 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
         self.num_iter = num_iter
         self.lam = lam
         self.num_features = num_features
+        #: per-block starting weights for the next fit (a λ-sweep warm
+        #: start from the nearest-λ neighbor's model); consumed and
+        #: cleared by ``fit`` — never part of the estimator's identity
+        self.warm_start_ws: Optional[Sequence] = None
 
     # passes over the input, for the auto-cache planner
     # (parity: BlockLinearMapper.scala:204)
     @property
     def weight(self) -> int:
         return 3 * self.num_iter + 1
+
+    # -- sweep grid hooks (keystone_tpu/sweep/) -------------------------
+
+    def grid_family(self):
+        return ("bcd", self.block_size, self.num_iter, self.num_features)
+
+    @staticmethod
+    def fit_lambda_grid(
+        estimators: Sequence["BlockLeastSquaresEstimator"], data, labels,
+        warm_start: bool = True,
+    ) -> List["BlockLinearMapper"]:
+        """Fit a λ grid of BCD members, each warm-started from the
+        nearest-λ neighbor already solved (ascending λ order). BCD is
+        iterative, so warm-started iterates differ from cold ones while
+        descending the same objective — a sweep only takes this path when
+        asked (``GridSweep(warm_start=True)``). Chunked inputs fall back
+        to independent cold fits (the streaming prediction buffer has no
+        cheap consistent warm initialization)."""
+        import copy
+
+        from ...data.chunked import ChunkedDataset
+
+        order = sorted(
+            range(len(estimators)), key=lambda i: estimators[i].lam or 0.0
+        )
+        models: List[Optional[BlockLinearMapper]] = [None] * len(estimators)
+        prev: Optional[BlockLinearMapper] = None
+        chunked = isinstance(data, ChunkedDataset)
+        for i in order:
+            est = copy.copy(estimators[i])
+            est.warm_start_ws = (
+                [w for w in prev.xs] if (warm_start and prev is not None
+                                         and not chunked) else None
+            )
+            models[i] = est.fit(data, labels)
+            prev = models[i]
+        return models
 
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
         """``data`` is either a Dataset of (n, d) features (split internally,
@@ -199,6 +311,8 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
         from ...linalg.bcd import _block_means, solve_blockwise_l2_scan
         from ...utils.timing import phase
 
+        warm = getattr(self, "warm_start_ws", None)  # pre-sweep pickles
+        self.warm_start_ws = None
         if isinstance(data, ChunkedDataset):
             return self._fit_streaming(data, labels)
 
@@ -235,10 +349,17 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
                 y_mean = jnp.mean(y, axis=0)
                 out.append((mean_vec, y_mean))
             with phase("block_ls.solve") as out:
+                init = None
+                if warm is not None:
+                    cat = jnp.concatenate(
+                        [jnp.asarray(w) for w in warm], axis=0
+                    )
+                    if cat.shape == (d, y.shape[1]):
+                        init = cat
                 W = solve_blockwise_l2_scan(
                     X, shard_batch(y - y_mean), reg=self.lam,
                     block_size=self.block_size, num_iter=self.num_iter,
-                    means=mean_vec,
+                    means=mean_vec, init=init,
                 )
                 out.append(W)
             ws = [
@@ -269,9 +390,15 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
             means, y_mean = _block_means(blocks, y)
             out.append(y_mean)
         with phase("block_ls.solve"):
+            init = None
+            if warm is not None and len(warm) == len(blocks) and all(
+                tuple(w.shape) == (int(b.shape[1]), int(y.shape[1]))
+                for w, b in zip(warm, blocks)
+            ):
+                init = [jnp.asarray(w) for w in warm]
             ws = solve_blockwise_l2(
                 blocks, shard_batch(y - y_mean), reg=self.lam,
-                num_iter=self.num_iter, means=means,
+                num_iter=self.num_iter, means=means, init=init,
             )
         return BlockLinearMapper(
             ws, self.block_size, b=y_mean, feature_means=means
@@ -369,6 +496,71 @@ class TSQRLeastSquaresEstimator(LabelEstimator, CostModel):
 
     def __init__(self, lam: float = 0.0):
         self.lam = lam
+
+    # -- sweep grid hooks (keystone_tpu/sweep/) -------------------------
+
+    def grid_family(self):
+        return ("tsqr",)
+
+    @staticmethod
+    def fit_lambda_grid(
+        estimators: Sequence["TSQRLeastSquaresEstimator"], data, labels
+    ) -> List[LinearMapper]:
+        """Fit a λ-only grid from ONE factorization: the R factor of the
+        UNregularized centered augmented matrix is λ-independent, and
+        ``qr([A; B]).R == qr([qr(A).R; B]).R`` (up to row signs, which
+        the triangular solve cancels) — so each member folds only its
+        √λ·I rows into the shared R, an O((d+k)³) fold against one
+        O(n·(d+k)²) factorization."""
+        from ...data.chunked import ChunkedDataset
+        from ...linalg.bcd import stream_column_means
+        from ...linalg.tsqr import _qr_fold, tsqr_r, tsqr_r_streaming
+        from ...utils.timing import phase
+
+        y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        chunked = isinstance(data, ChunkedDataset)
+        with phase("tsqr_ls.grid_factorize") as out:
+            if chunked:
+                a_mean, n = stream_column_means(data.raw_chunks)
+                if n != y.shape[0]:
+                    raise ValueError(
+                        f"chunked features have {n} rows, labels {y.shape[0]}"
+                    )
+                y_mean = jnp.mean(y, axis=0)
+                d = int(a_mean.shape[0])
+
+                def augmented():
+                    offset = 0
+                    for chunk in data.raw_chunks():
+                        chunk = jnp.asarray(chunk, dtype=jnp.float32)
+                        rows = int(chunk.shape[0])
+                        yield jnp.concatenate(
+                            [chunk - a_mean,
+                             y[offset : offset + rows] - y_mean],
+                            axis=1,
+                        )
+                        offset += rows
+
+                R_base = tsqr_r_streaming(augmented)
+            else:
+                A = jnp.asarray(
+                    Dataset.of(data).to_array(), dtype=jnp.float32
+                )
+                a_mean = jnp.mean(A, axis=0)
+                y_mean = jnp.mean(y, axis=0)
+                d = int(A.shape[1])
+                R_base = tsqr_r(
+                    jnp.concatenate([A - a_mean, y - y_mean], axis=1)
+                )
+            out.append(R_base)
+        k = int(y.shape[1])
+        models = []
+        for est in estimators:
+            reg = est._reg_rows(d, k)
+            R = R_base if reg is None else _qr_fold(R_base, reg)
+            W = TSQRLeastSquaresEstimator._solve_from_r(R, d)
+            models.append(LinearMapper(W, b=y_mean, feature_mean=a_mean))
+        return models
 
     @staticmethod
     def _solve_from_r(R, d: int):

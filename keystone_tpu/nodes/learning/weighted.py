@@ -22,12 +22,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...data.pipeline_scan import scan_pipeline
 from ...data.dataset import Dataset
 from ...linalg.row_matrix import solve_spd
 from ...parallel.mesh import shard_classes
 from ...utils.jit import nestable_jit
+from ...workflow.node_optimization import Optimizable
 from ...workflow.transformer import LabelEstimator
+from .cost import AutoSolverFrontDoor, CostModel, combine_cost
 from .linear import BlockLinearMapper
 
 
@@ -68,18 +69,9 @@ def _chunk_grams(A, mask_chunk):
     return jnp.einsum("nd,nc,ne->cde", A, mask_chunk, A)
 
 
-@jax.jit
-def _batched_solve(jointXTX, rhs, lam):
-    """(C, d, d), (C, d) → (C, d) batched ridge solves.
-
-    LU with partial pivoting, not Cholesky: per-class covariances are
-    rank-deficient whenever d exceeds the class count (ImageNet FV:
-    d=4096, tens of images per class), and f32 Cholesky NaNs on the
-    resulting near-semidefinite jointXTX. The reference survives because
-    Breeze's ``\\`` is f64 LU (BlockWeightedLeastSquares.scala:294)."""
-    d = jointXTX.shape[-1]
-    G = jointXTX + lam * jnp.eye(d, dtype=jointXTX.dtype)
-    return jnp.linalg.solve(G, rhs[..., None])[..., 0]
+# batched per-class ridge solve — shared with the streaming solver body,
+# which now lives at the linalg layer (K-lane mesh distribution included)
+from ...linalg.weighted import _batched_solve, solve_weighted_streaming
 
 
 @nestable_jit
@@ -131,9 +123,11 @@ def _dual_solve_chunk(Q, R, dvec, pm_proj, mu_proj, s3, rhs, lam):
     return jax.vmap(one)(dvec, mu_proj, rhs)
 
 
-class BlockWeightedLeastSquaresEstimator(LabelEstimator):
+class BlockWeightedLeastSquaresEstimator(LabelEstimator, CostModel):
     """(parity: BlockWeightedLeastSquaresEstimator,
     BlockWeightedLeastSquares.scala:36-84)."""
+
+    supports_streaming = True
 
     def __init__(self, block_size: int, num_iter: int, lam: float,
                  mixture_weight: float,
@@ -150,6 +144,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     @property
     def weight(self) -> int:
         return 3 * self.num_iter + 1
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        from ...linalg.weighted import cost_signature
+
+        return combine_cost(
+            cost_signature(
+                n, self.num_features or d, k, self.block_size,
+                self.num_iter, num_machines, self.class_chunk,
+            ),
+            cpu_weight, mem_weight, network_weight,
+        )
 
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
         from ...data.chunked import ChunkedDataset
@@ -328,7 +334,6 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
         return BlockLinearMapper(Ws, self.block_size, b=b)
 
-    @_f32_true
     def train_streaming(self, data, Y) -> BlockLinearMapper:
         """Out-of-core weighted solve: the featurized design matrix streams
         through in row chunks and NEVER materializes (parity: the
@@ -337,25 +342,25 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         partitions from cluster RAM; here the chunked source recomputes
         them, lineage-style).
 
-        Resident state: labels/residual (n, k), the per-block joint stats,
-        one (C, bs, bs) masked-Gram accumulator, and one chunk. Scan count:
-        num_iter × nblocks × (1 + ⌈k/C⌉) — the class-chunked Gram passes
-        are the price of never holding the (k, bs, bs) per-class Grams; the
-        reference pays the same shape as one shuffle of the full data to
-        class-keyed partitions. The same delayed-residual-update trick as
-        the streaming BCD fuses ``R −= A_prev·Δ_prev`` into the next block's
-        accumulation scan."""
-        from ...utils.timing import phase
-
-        w = self.mixture_weight
-        lam = self.lam
-        n, k = Y.shape
+        The solver body lives at the linalg layer
+        (:func:`~keystone_tpu.linalg.weighted.solve_weighted_streaming`),
+        mesh-distributed across the data-axis scan lanes with per-lane
+        partial accumulators reduced once per block. Resident state:
+        labels/residual (n, k) — as per-lane slabs when laned — the
+        per-block joint stats, one (C, bs, bs) masked-Gram accumulator,
+        and one chunk. Scan count: num_iter × nblocks × (1 + ⌈k/C⌉) — the
+        class-chunked Gram passes are the price of never holding the
+        (k, bs, bs) per-class Grams; the reference pays the same shape as
+        one shuffle of the full data to class-keyed partitions. The same
+        delayed-residual-update trick as the streaming BCD fuses
+        ``R −= A_prev·Δ_prev`` into the next block's accumulation scan."""
+        n = Y.shape[0]
         if len(data) != n:
             raise ValueError(
                 f"chunked features have {len(data)} rows, labels {n}"
             )
-        # raw (unpipelined) scans compose here; the consuming loops below
-        # wrap them in scan_pipeline so exactly ONE pipeline runs per scan
+        # raw (unpipelined) scans compose here; the solver wraps them in
+        # scan_pipeline so exactly ONE pipeline runs per scan
         if self.num_features is not None:
             dcap = self.num_features
             base_scan = data.raw_chunks
@@ -367,204 +372,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         else:
             scan = data.raw_chunks
 
-        y_idx = jnp.argmax(Y, axis=1)
-        counts = jnp.zeros((k,), jnp.float32).at[y_idx].add(1.0)
-        safe_counts = jnp.maximum(counts, 1.0)
-        joint_label_mean = 2 * w + 2 * (1 - w) * counts / n - 1.0
-        R = Y - joint_label_mean
-
-        d = None
-        for chunk in scan():
-            d = int(chunk.shape[-1])
-            break
-        if d is None:
-            raise ValueError("empty chunk source")
-        starts: List[int] = list(range(0, d, self.block_size))
-        sizes: List[int] = [
-            min(self.block_size, d - j0) for j0 in starts
-        ]
-        nblocks = len(starts)
-        Ws: List[jnp.ndarray] = [
-            jnp.zeros((bs, k), dtype=jnp.float32) for bs in sizes
-        ]
-        stats = [None] * nblocks  # (pop_cov, pop_mean, joint_means, class_means)
-        delta_prev = None
-        jprev, prev_bs = 0, sizes[0]
-
-        for _ in range(self.num_iter):
-            for bidx, (j0, bs) in enumerate(zip(starts, sizes)):
-                do_stats = stats[bidx] is None
-                xtR = jnp.zeros((bs, k), jnp.float32)
-                xtRc = jnp.zeros((bs, k), jnp.float32)
-                G = jnp.zeros((bs, bs), jnp.float32)
-                class_sums = jnp.zeros((k, bs), jnp.float32)
-                pop_sum = jnp.zeros((bs,), jnp.float32)
-                row0 = 0
-                with phase("wls.stream_cross") as out:
-                    for chunk in scan_pipeline(scan(), label="wls.stream"):
-                        chunk = jnp.asarray(chunk, dtype=jnp.float32)
-                        R, xtR, xtRc, G, class_sums, pop_sum = _wls_scan1(
-                            chunk, R,
-                            delta_prev
-                            if delta_prev is not None
-                            else jnp.zeros((prev_bs, k), jnp.float32),
-                            y_idx, xtR, xtRc, G, class_sums, pop_sum,
-                            row0, jprev, j0,
-                            bs=bs, prev_bs=prev_bs, k=k,
-                            do_prev=delta_prev is not None,
-                            do_stats=do_stats,
-                        )
-                        row0 += int(chunk.shape[0])
-                    if row0 != n:
-                        raise ValueError(
-                            f"chunk source produced {row0} rows, labels {n}"
-                        )
-                    out.append(xtR)
-                if do_stats:
-                    pop_mean = pop_sum / n
-                    class_means = class_sums / safe_counts[:, None]
-                    joint_means = w * class_means + (1 - w) * pop_mean
-                    pop_cov = G / n - jnp.outer(pop_mean, pop_mean)
-                    stats[bidx] = (pop_cov, pop_mean, joint_means, class_means)
-                pop_cov, pop_mean, joint_means, class_means = stats[bidx]
-                pop_xtr = xtR / n
-                class_xtr = xtRc / safe_counts[None, :]
-                residual_mean = jnp.mean(R, axis=0)
-                vals = jnp.take_along_axis(R, y_idx[:, None], axis=1)[:, 0]
-                class_r_mean = (
-                    jnp.zeros((k,), jnp.float32).at[y_idx].add(vals)
-                    / safe_counts
-                )
-
-                # masked-Gram accumulator sized to ≥ class_chunk classes,
-                # grown until C·bs² reaches ~256 MB f32 (fewer data scans)
-                C = max(
-                    1,
-                    min(k, max(self.class_chunk, (1 << 26) // max(bs * bs, 1))),
-                )
-                delta_cols = []
-                for c0 in range(0, k, C):
-                    Ccur = min(C, k - c0)
-                    # class-sharded accumulator: each model-axis device owns
-                    # a class slice of the einsum + solve (the streaming twin
-                    # of the in-memory path's shard_classes(onehot) layout)
-                    grams = shard_classes(
-                        jnp.zeros((Ccur, bs, bs), jnp.float32)
-                    )
-                    row0 = 0
-                    with phase("wls.stream_grams") as out:
-                        for chunk in scan_pipeline(scan(), label="wls.stream"):
-                            chunk = jnp.asarray(chunk, dtype=jnp.float32)
-                            grams = _wls_scan2(
-                                chunk, y_idx, grams, row0, j0, c0,
-                                bs=bs, C=Ccur,
-                            )
-                            row0 += int(chunk.shape[0])
-                        out.append(grams)
-                    cs = slice(c0, c0 + Ccur)
-                    mu_c = class_means[cs]
-                    mean_diff = mu_c - pop_mean
-                    mean_mixture = (
-                        (1 - w) * residual_mean[cs] + w * class_r_mean[cs]
-                    )
-                    jointXTR = (
-                        (1 - w) * pop_xtr[:, cs].T
-                        + w * class_xtr[:, cs].T
-                        - joint_means[cs] * mean_mixture[:, None]
-                    )
-                    rhs = jointXTR - lam * Ws[bidx][:, cs].T
-                    cnt = counts[cs][:, None, None]
-                    class_cov = grams / jnp.maximum(cnt, 1.0) - jnp.einsum(
-                        "cd,ce->cde", mu_c, mu_c
-                    )
-                    jointXTX = (
-                        (1 - w) * pop_cov
-                        + w * class_cov
-                        + w * (1 - w) * jnp.einsum(
-                            "cd,ce->cde", mean_diff, mean_diff
-                        )
-                    )
-                    delta_cols.append(
-                        _batched_solve(
-                            shard_classes(jointXTX), shard_classes(rhs), lam
-                        )
-                    )
-                delta = jnp.concatenate(delta_cols, axis=0).T  # (bs, k)
-                Ws[bidx] = Ws[bidx] + delta
-                delta_prev, jprev, prev_bs = delta, j0, bs
-
-        b = joint_label_mean - sum(
-            jnp.einsum("cd,dc->c", stats[j][2], Ws[j])
-            for j in range(nblocks)
+        Ws, b = solve_weighted_streaming(
+            scan, Y,
+            block_size=self.block_size, num_iter=self.num_iter,
+            lam=self.lam, mixture_weight=self.mixture_weight,
+            class_chunk=self.class_chunk,
         )
         return BlockLinearMapper(Ws, self.block_size, b=b)
-
-
-def _wls_stream_scan1_impl(
-    A_chunk, R, delta_prev, y_idx, xtR, xtRc, G, class_sums, pop_sum,
-    row0, jprev, jcur, *, bs, prev_bs, k, do_prev, do_stats,
-):
-    """Per-chunk program for a streaming weighted block step: applies the
-    previous block's delayed residual update, then accumulates this block's
-    raw-A cross terms (and, on the first epoch, its Gram + class sums)."""
-    rows = A_chunk.shape[0]
-    Ac = jax.lax.dynamic_slice_in_dim(A_chunk, jcur, bs, axis=1)
-    Rc = jax.lax.dynamic_slice_in_dim(R, row0, rows, axis=0)
-    if do_prev:
-        Ap = jax.lax.dynamic_slice_in_dim(A_chunk, jprev, prev_bs, axis=1)
-        Rc = Rc - jnp.matmul(Ap, delta_prev)
-        R = jax.lax.dynamic_update_slice_in_dim(R, Rc, row0, axis=0)
-    yc = jax.lax.dynamic_slice_in_dim(y_idx, row0, rows, axis=0)
-    oh = jax.nn.one_hot(yc, k, dtype=A_chunk.dtype)  # (rows, k)
-    xtR = xtR + jnp.matmul(Ac.T, Rc)
-    xtRc = xtRc + jnp.matmul(Ac.T, oh * Rc)
-    if do_stats:
-        G = G + jnp.matmul(Ac.T, Ac)
-        class_sums = class_sums + jnp.matmul(oh.T, Ac)
-        pop_sum = pop_sum + jnp.sum(Ac, axis=0)
-    return R, xtR, xtRc, G, class_sums, pop_sum
-
-
-def _wls_stream_scan2_impl(A_chunk, y_idx, grams, row0, jcur, c0, *, bs, C):
-    """Per-chunk masked-Gram accumulation for classes [c0, c0+C)."""
-    rows = A_chunk.shape[0]
-    Ac = jax.lax.dynamic_slice_in_dim(A_chunk, jcur, bs, axis=1)
-    yc = jax.lax.dynamic_slice_in_dim(y_idx, row0, rows, axis=0)
-    local = yc - c0
-    in_range = (local >= 0) & (local < C)
-    mask = jax.nn.one_hot(
-        jnp.where(in_range, local, 0), C, dtype=A_chunk.dtype
-    ) * in_range[:, None].astype(A_chunk.dtype)
-    return grams + jnp.einsum("nd,nc,ne->cde", Ac, mask, Ac)
-
-
-_wls_scan1_donating = jax.jit(
-    _wls_stream_scan1_impl,
-    static_argnames=("bs", "prev_bs", "k", "do_prev", "do_stats"),
-    donate_argnums=(1, 4, 5, 6, 7, 8),
-)
-_wls_scan1_plain = jax.jit(
-    _wls_stream_scan1_impl,
-    static_argnames=("bs", "prev_bs", "k", "do_prev", "do_stats"),
-)
-_wls_scan2_donating = jax.jit(
-    _wls_stream_scan2_impl, static_argnames=("bs", "C"), donate_argnums=(2,)
-)
-_wls_scan2_plain = jax.jit(
-    _wls_stream_scan2_impl, static_argnames=("bs", "C")
-)
-
-
-def _wls_scan1(*args, **kwargs):
-    if jax.default_backend() == "cpu":
-        return _wls_scan1_plain(*args, **kwargs)
-    return _wls_scan1_donating(*args, **kwargs)
-
-
-def _wls_scan2(*args, **kwargs):
-    if jax.default_backend() == "cpu":
-        return _wls_scan2_plain(*args, **kwargs)
-    return _wls_scan2_donating(*args, **kwargs)
 
 
 def _joint_weighted_stats(X, Y, w):
@@ -592,7 +406,7 @@ def _class_sample_weights(y_idx, counts, c, w, n):
     )
 
 
-class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
+class PerClassWeightedLeastSquaresEstimator(LabelEstimator, CostModel):
     """Same objective solved exactly, class-at-a-time, as a dense weighted
     ridge — the reference uses it as the agreement oracle for the block
     solver (parity: PerClassWeightedLeastSquares.scala:31-63;
@@ -607,6 +421,21 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         self.lam = lam
         self.mixture_weight = mixture_weight
         self.num_features = num_features
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        # exact per-class dense ridge: every class pays the full weighted
+        # Gram (2·n·d²) plus a d³ factorization, and re-reads X
+        d = self.num_features or d
+        return combine_cost(
+            {
+                "flops": k * (2.0 * n * d * d + d ** 3 / 3.0) / num_machines,
+                "bytes": k * (n * d / num_machines + d * d),
+                "network": d * (d + k),
+                "passes": k,
+            },
+            cpu_weight, mem_weight, network_weight,
+        )
 
     @_f32_true
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
@@ -705,7 +534,7 @@ def _reweighted_block_update(Aj, mj, G, Wj_old, R, y_zm, b, reg):
     return Wj, R
 
 
-class ReWeightedLeastSquaresEstimator(LabelEstimator):
+class ReWeightedLeastSquaresEstimator(LabelEstimator, CostModel):
     """Per-class weighted least squares solved by the ITERATIVE reweighted
     BCD (parity: PerClassWeightedLeastSquares.scala:97-110 driving
     internal/ReWeightedLeastSquares.scala:18). Third agreement point for
@@ -720,6 +549,24 @@ class ReWeightedLeastSquaresEstimator(LabelEstimator):
         self.lam = lam
         self.mixture_weight = mixture_weight
         self.num_features = num_features
+
+    def cost(self, n, d, k, sparsity, num_machines,
+             cpu_weight, mem_weight, network_weight):
+        # per class: weighted per-block Grams once (n·d·bs), then
+        # num_iter residual/solve sweeps (2·n·d GEMV-shaped + d·bs² solves)
+        d = self.num_features or d
+        bs = min(self.block_size, d)
+        return combine_cost(
+            {
+                "flops": k * (
+                    n * d * bs + self.num_iter * (2.0 * n * d + d * bs * bs)
+                ) / num_machines,
+                "bytes": k * self.num_iter * (n * d / num_machines + d),
+                "network": d * (bs + k),
+                "passes": k * self.num_iter,
+            },
+            cpu_weight, mem_weight, network_weight,
+        )
 
     @_f32_true
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
@@ -755,3 +602,64 @@ class ReWeightedLeastSquaresEstimator(LabelEstimator):
             W[i : min(i + self.block_size, d)] for i in splits
         ]
         return BlockLinearMapper(ws, self.block_size, b=b)
+
+
+class WeightedLeastSquaresEstimator(
+    LabelEstimator, AutoSolverFrontDoor, CostModel, Optimizable
+):
+    """Cost-model auto-selecting front door for the weighted family — the
+    class-weighted analogue of ``LeastSquaresEstimator``. All three
+    physical solvers optimize the same mixture objective (the agreement
+    contract pinned by the weighted parity tests), so selection is purely
+    a cost question: the block solver streams and shares per-block Grams
+    across classes, the per-class oracle is exact but pays k dense d×d
+    factorizations, the reweighted BCD sits between. Selection runs
+    through :class:`keystone_tpu.cost.SolverChooser`, so with a profile
+    store configured (``KEYSTONE_PROFILE_DIR``) the family earns learned
+    ``op/`` seconds-per-unit profiles from traced fits and future choices
+    rank by predicted wall-clock."""
+
+    def __init__(self, block_size: int, num_iter: int, lam: float,
+                 mixture_weight: float,
+                 num_features: Optional[int] = None,
+                 num_machines: Optional[int] = None,
+                 cpu_weight: Optional[float] = None,
+                 mem_weight: Optional[float] = None,
+                 network_weight: Optional[float] = None):
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.lam = lam
+        self.mixture_weight = mixture_weight
+        self.num_features = num_features
+        self.num_machines = num_machines
+        self._init_chooser_weights(cpu_weight, mem_weight, network_weight)
+        args = (block_size, num_iter, lam, mixture_weight)
+        self.options: Sequence = [
+            BlockWeightedLeastSquaresEstimator(
+                *args, num_features=num_features
+            ),
+            PerClassWeightedLeastSquaresEstimator(
+                *args, num_features=num_features
+            ),
+            ReWeightedLeastSquaresEstimator(
+                *args, num_features=num_features
+            ),
+        ]
+        self.default = self.options[0]
+
+    def fit(self, data, labels: Dataset) -> BlockLinearMapper:
+        from ...data.chunked import ChunkedDataset
+
+        if isinstance(data, (list, tuple)):
+            # pre-split block list: only the block solver understands it
+            # (the per-class/reweighted options stack a dense (n, d)), and
+            # the list container would corrupt the shape signature
+            # (n = block count, not rows) — skip the chooser
+            return self.default.fit(data, labels)
+        chunked = isinstance(data, ChunkedDataset)
+        sample = data.take(24) if chunked else Dataset.of(data)
+        solver = self.sample_optimize(
+            [sample, Dataset.of(labels)],
+            len(Dataset.of(data)), chunked=chunked,
+        )
+        return solver.fit(data if chunked else Dataset.of(data), labels)

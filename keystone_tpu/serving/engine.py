@@ -121,28 +121,8 @@ class ServingEngine:
         # exported executable — a warm boot pays ZERO traces ("aot_loads"
         # counts them) — and a miss traces once, then exports for the next
         # process.
-        import jax
-
-        fn = fitted.trace_fn()
-        if fn is None:
-            raise NotTraceableError(fitted.untraceable_nodes())
-        signatures: list = []
-        self._compiled_signatures = signatures
-        metrics_ref = self._metrics
-
-        def _note_trace(sig):
-            signatures.append(sig)
-            metrics_ref.inc("compiles")
-
-        self._aot = self._build_aot_dispatcher(fitted, fn, _note_trace)
-        if self._aot is not None:
-            self._compiled = self._aot
-        else:
-            def _traced(x):
-                _note_trace((tuple(x.shape), str(x.dtype)))
-                return fn(x)
-
-            self._compiled = jax.jit(_traced)
+        self._compiled_signatures: list = []
+        self._compiled = self._compile_for(fitted)
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._max_wait = max_wait_ms / 1000.0
         self._log_interval = log_interval_s
@@ -160,6 +140,33 @@ class ServingEngine:
         self._ran = False  # distinguishes never-started from shut-down
         self._thread: Optional[threading.Thread] = None
         self._metrics.set_gauge("queue_depth", self._queue.qsize)
+
+    def _compile_for(self, fitted: FittedPipeline):
+        """Strictly compile ``fitted`` against this engine's private trace
+        accounting (the ``compiles`` counter + signature list): the
+        constructor's compile path, shared by :meth:`swap` so a replacement
+        model's traces are audited exactly like the original's."""
+        import jax
+
+        fn = fitted.trace_fn()
+        if fn is None:
+            raise NotTraceableError(fitted.untraceable_nodes())
+        signatures = self._compiled_signatures
+        metrics_ref = self._metrics
+
+        def _note_trace(sig):
+            signatures.append(sig)
+            metrics_ref.inc("compiles")
+
+        aot = self._build_aot_dispatcher(fitted, fn, _note_trace)
+        if aot is not None:
+            return aot
+
+        def _traced(x):
+            _note_trace((tuple(x.shape), str(x.dtype)))
+            return fn(x)
+
+        return jax.jit(_traced)
 
     def _build_aot_dispatcher(self, fitted, fn, note_trace):
         """The engine's PRIVATE cache-aware compile path (same isolation
@@ -269,6 +276,102 @@ class ServingEngine:
             self._thread.start()
             self._ran = True
         return self
+
+    def swap(self, fitted: FittedPipeline, *, warmup: Optional[bool] = None) -> int:
+        """Atomically replace the served model with ``fitted`` — the
+        publish step of an incremental refit (``FittedPipeline.absorb``).
+
+        The replacement compiles strictly and pre-warms every bucket OFF
+        the serving path (with an AOT cache configured the warmed buckets
+        load, zero traces); only then does the engine's dispatch reference
+        flip — one atomic store, read once per micro-batch at dispatch
+        time. No request is ever dropped: every batch runs whole on
+        exactly one executable (whichever the worker reads when it
+        dispatches — a batch gathered just before the flip may run on the
+        new model), and admission never pauses.
+
+        The new pipeline must satisfy the engine's existing datum contract
+        (shape + dtype) and bucket policy — re-bucketing a live engine is
+        a restart, not a swap. ``warmup`` follows :meth:`start`'s
+        semantics: None warms when the shape is known, True demands it,
+        False flips cold (the first batch per bucket pays its compile).
+        Returns the number of buckets warmed.
+        """
+        coupled = fitted.batch_coupled_nodes()
+        if coupled:
+            raise ValueError(
+                f"cannot swap in a batch-coupled chain ({coupled[0]}): "
+                "bucket padding would corrupt its whole-batch statistics"
+            )
+        new_shape = getattr(fitted, "datum_shape", None)
+        cur_shape = self._policy.datum_shape
+        if (
+            new_shape is not None and cur_shape is not None
+            and tuple(new_shape) != tuple(cur_shape)
+        ):
+            raise ValueError(
+                f"swap datum shape {tuple(new_shape)} does not match the "
+                f"engine's contract {tuple(cur_shape)} — start a new engine "
+                "for a re-shaped model"
+            )
+        import numpy as _np
+
+        new_dtype = getattr(fitted, "datum_dtype", None)
+        if (
+            new_dtype is not None
+            and _np.dtype(new_dtype) != self._policy.dtype
+        ):
+            raise ValueError(
+                f"swap datum dtype {_np.dtype(new_dtype)} does not match "
+                f"the engine's contract {self._policy.dtype} — batches "
+                "would silently cast; start a new engine for a re-typed "
+                "model"
+            )
+        with self._lifecycle_lock:
+            if self._closed:
+                raise EngineClosed("engine is draining / shut down")
+            compiles_before = self._metrics.count("compiles")
+            loads_before = self._metrics.count("aot_loads")
+            compiled = self._compile_for(fitted)
+            warmed = 0
+            if (warmup or warmup is None) and cur_shape is not None:
+                import jax
+
+                for x in self._policy.warmup_inputs():
+                    jax.block_until_ready(compiled(x))
+                    warmed += 1
+            elif warmup is True:
+                raise ValueError(
+                    "swap(warmup=True) but no datum shape is known — the "
+                    "engine cannot pre-pay the replacement's compiles"
+                )
+            # THE swap: one reference store, read once per batch by the
+            # worker at dispatch time — each batch runs whole on exactly
+            # one executable, never a mix
+            self._compiled = compiled
+            self._fitted = fitted
+            self._metrics.inc("swaps")
+            tracer = _trace_current()
+            if tracer is not None:
+                with tracer.span(
+                    "serve.swap",
+                    op_type="ServingEngine",
+                    buckets_warmed=warmed,
+                    compiles=self._metrics.count("compiles") - compiles_before,
+                    aot_loads=self._metrics.count("aot_loads") - loads_before,
+                    queue_depth=self._queue.qsize(),
+                    live=self._thread is not None,
+                ):
+                    pass
+            logger.info(
+                "serving swap: model replaced (%d bucket(s) warmed, "
+                "%d traced, %d AOT-loaded; queue depth %d)",
+                warmed,
+                self._metrics.count("compiles") - compiles_before,
+                self._metrics.count("aot_loads") - loads_before,
+                self._queue.qsize(),
+            )
+            return warmed
 
     def drain(self) -> None:
         """Stop admitting, answer every queued request, stop the worker.

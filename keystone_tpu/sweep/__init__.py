@@ -1,0 +1,15 @@
+"""Multi-query optimization: hyperparameter sweeps fit as ONE merged DAG.
+
+KeystoneML's headline optimizations — common-subexpression elimination and
+profile-guided caching — pay off most when many pipeline variants share
+work. :class:`GridSweep` is that workload: a pipeline template plus a
+parameter grid, fit as one graph so the shared featurize prefix executes
+exactly once, solver structure is exploited across grid members (one Gram
+accumulation prices every λ; BCD members warm-start from their nearest-λ
+neighbor), and the fitted members come back as ordinary
+``FittedPipeline``\\ s.
+"""
+
+from .grid import GridSweep, SweepMember, SweepResult
+
+__all__ = ["GridSweep", "SweepMember", "SweepResult"]

@@ -118,6 +118,58 @@ class PipelineDatum(PipelineResult):
 
 
 # ---------------------------------------------------------------------------
+# Fit instrumentation: the tracer + cost-model loop around any fit
+# ---------------------------------------------------------------------------
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def fit_instrumentation(op_type: str, span_name: str = "pipeline.fit"):
+    """The observe-and-learn wrapper every fit runs under — a root span,
+    and (with a profile store configured) a pending re-plan joined against
+    the fit's observed per-node costs afterwards. Shared by
+    :meth:`Pipeline.fit` and the multi-query sweep
+    (:mod:`keystone_tpu.sweep`), whose merged DAG earns its own plan
+    records through exactly this loop."""
+    from .. import cost as cost_mod
+    from ..obs import tracer as obs_tracer_mod
+
+    store = cost_mod.get_store()
+    tracer = _trace_current()
+    own_tracer = None
+    if store is not None and tracer is None:
+        # install-if-absent: two concurrent fits race for the global
+        # slot. The loser must NOT learn: joining the winner's tracer
+        # would merge both fits' spans per small-int node id and
+        # persist cross-fit sums into both evidence records — so the
+        # loser runs a plain fit (no tracer, no pending plan) and the
+        # winner's tracer is never torn down mid-fit.
+        own_tracer = obs_tracer_mod.install_if_absent(
+            obs_tracer_mod.Tracer()
+        )
+        tracer = own_tracer
+        if own_tracer is None:
+            store = None
+    try:
+        with cost_mod.pending_plan(store) as plan:
+            if plan is not None and tracer is not None:
+                plan.span_watermark = len(tracer.spans())
+            if tracer is None:
+                yield
+            else:
+                with tracer.span(span_name, op_type=op_type):
+                    yield
+            # after the fit span closes: every node span is complete,
+            # so the estimate-vs-observed join sees the whole run
+            cost_mod.finalize(plan, tracer)
+    finally:
+        if own_tracer is not None:
+            obs_tracer_mod.uninstall(own_tracer)
+
+
+# ---------------------------------------------------------------------------
 # Graph-building helpers
 # ---------------------------------------------------------------------------
 
@@ -323,43 +375,8 @@ class Pipeline(Chainable):
         evidence persists so the NEXT fit of this pipeline plans with zero
         sampling executions. A fit-local tracer is installed when none is
         active — observations are what the loop learns from."""
-        from .. import cost as cost_mod
-        from ..obs import tracer as obs_tracer_mod
-
-        store = cost_mod.get_store()
-        tracer = _trace_current()
-        own_tracer = None
-        if store is not None and tracer is None:
-            # install-if-absent: two concurrent fits race for the global
-            # slot. The loser must NOT learn: joining the winner's tracer
-            # would merge both fits' spans per small-int node id and
-            # persist cross-fit sums into both evidence records — so the
-            # loser runs a plain fit (no tracer, no pending plan) and the
-            # winner's tracer is never torn down mid-fit.
-            own_tracer = obs_tracer_mod.install_if_absent(
-                obs_tracer_mod.Tracer()
-            )
-            tracer = own_tracer
-            if own_tracer is None:
-                store = None
-        try:
-            with cost_mod.pending_plan(store) as plan:
-                if plan is not None and tracer is not None:
-                    plan.span_watermark = len(tracer.spans())
-                if tracer is None:
-                    fitted = self._fit()
-                else:
-                    with tracer.span(
-                        "pipeline.fit", op_type=type(self).__name__
-                    ):
-                        fitted = self._fit()
-                # after the fit span closes: every node span is complete,
-                # so the estimate-vs-observed join sees the whole run
-                cost_mod.finalize(plan, tracer)
-            return fitted
-        finally:
-            if own_tracer is not None:
-                obs_tracer_mod.uninstall(own_tracer)
+        with fit_instrumentation(type(self).__name__):
+            return self._fit()
 
     def _fit(self) -> "FittedPipeline":
         optimizer = PipelineEnv.get_or_create().optimizer
@@ -786,6 +803,123 @@ class FittedPipeline(Chainable):
             outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0),
             batched=True,
         )
+
+    # -- incremental refit ----------------------------------------------
+
+    def absorbable_nodes(self) -> List[NodeId]:
+        """Nodes carrying a snapshot-able solver state (see
+        ``linalg/accumulators.py``) — the models :meth:`absorb` can fold
+        appended chunks into."""
+        return [
+            n
+            for n in self._graph.nodes
+            if getattr(self._graph.get_operator(n), "solver_state", None)
+            is not None
+        ]
+
+    def absorb(self, new_data: Any, new_labels: Any) -> "FittedPipeline":
+        """Fold appended training chunks into the fitted model WITHOUT a
+        from-scratch refit.
+
+        The terminal solver must have been fit with a snapshot-able
+        accumulator (``LinearMapEstimator(snapshot=True)`` or any sweep
+        Gram-family member): its saved
+        :class:`~keystone_tpu.linalg.accumulators.GramSolverState` holds
+        the raw Gram/cross/mean sums of everything seen so far, so the
+        update is (a) featurize ONLY the new chunks through this
+        pipeline's frozen prefix, (b) fold them into the accumulators,
+        (c) re-solve at the recorded λ — O(new chunks + d³) total. The
+        old training data is never touched.
+
+        Upstream fitted transformers (scalers, PCA, ...) stay FROZEN:
+        refitting them would change the featurization of every
+        previously-absorbed row, which only a full refit can do
+        consistently. Returns a NEW FittedPipeline (this one is
+        unchanged) — publish it to a live engine with
+        ``ServingEngine.swap``.
+        """
+        from ..data.chunked import ChunkedDataset
+        from ..data.dataset import Dataset as _Dataset
+
+        nodes = self.absorbable_nodes()
+        if not nodes:
+            raise ValueError(
+                "absorb needs a model fit with a snapshot-able solver "
+                "state — fit with LinearMapEstimator(snapshot=True) or a "
+                "GridSweep Gram-family member"
+            )
+        if len(nodes) > 1:
+            labels = [self._graph.get_operator(n).label for n in nodes]
+            raise ValueError(
+                f"absorb is ambiguous: {len(nodes)} solver-state nodes "
+                f"({', '.join(labels)})"
+            )
+        (node,) = nodes
+        mapper = self._graph.get_operator(node)
+        state = mapper.solver_state.snapshot()
+
+        deps = self._graph.get_dependencies(node)
+        if len(deps) != 1:
+            raise ValueError(
+                f"absorb expects a single-input model node, got {len(deps)} deps"
+            )
+        # featurize the NEW chunks through the frozen prefix: this
+        # pipeline's graph with a sink moved to the model's input —
+        # executed WITHOUT re-optimizing (same invariant as apply():
+        # re-fusing a fitted graph can change float32 program
+        # partitioning vs what the solver trained on)
+        prefix_graph, prefix_sink = self._graph.add_sink(deps[0])
+        prefix_graph, data_id = attach_data(prefix_graph, new_data)
+        prefix_graph = prefix_graph.replace_dependency(self._source, data_id)
+        prefix_graph = prefix_graph.remove_source(self._source)
+        prefix_exec = GraphExecutor(prefix_graph, optimize=False)
+
+        tracer = _trace_current()
+        with contextlib.ExitStack() as stack:
+            if tracer is not None:
+                sp = stack.enter_context(
+                    tracer.span(
+                        "pipeline.absorb",
+                        op_type=type(self).__name__,
+                        prior_rows=int(state.n),
+                    )
+                )
+            else:
+                sp = None
+            import jax.numpy as jnp
+
+            feats = prefix_exec.execute(prefix_sink).get()
+            y = jnp.asarray(
+                _Dataset.of(new_labels).to_array(), dtype=jnp.float32
+            )
+            if isinstance(feats, ChunkedDataset):
+                offset = 0
+                for chunk in feats.raw_chunks():
+                    rows = int(chunk.shape[0])
+                    state.update(chunk, y[offset : offset + rows])
+                    offset += rows
+                if offset != int(y.shape[0]):
+                    raise ValueError(
+                        f"new chunks have {offset} rows, labels {y.shape[0]}"
+                    )
+            else:
+                state.update(_Dataset.of(feats).to_array(), y)
+            W, b, mean = state.solve(state.lam)
+            if sp is not None:
+                sp.attrs["absorbed_rows"] = int(state.rows_folded)
+                sp.attrs["total_rows"] = int(state.n)
+                sp.sync_on(W)
+        new_mapper = type(mapper)(
+            W, b=b, feature_mean=mean, solver_state=state.snapshot()
+        )
+        updated = FittedPipeline(
+            self._graph.set_operator(node, new_mapper),
+            self._source,
+            self._sink,
+            datum_shape=self.datum_shape,
+            datum_dtype=self.datum_dtype,
+        )
+        return updated
 
     # -- persistence ----------------------------------------------------
 

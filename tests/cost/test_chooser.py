@@ -138,6 +138,127 @@ def test_solver_costs_fallback_spu_geometric_mean(tmp_path):
 # -- graph-level integration ------------------------------------------------
 
 
+# -- KRR / weighted families (ROADMAP PR-8 follow-on) -----------------------
+
+
+def test_weighted_family_cold_matches_argmin():
+    """The weighted front door must reproduce the analytic argmin over
+    its three physical solvers when no evidence exists."""
+    from keystone_tpu.nodes.learning import WeightedLeastSquaresEstimator
+
+    auto = WeightedLeastSquaresEstimator(
+        block_size=128, num_iter=3, lam=1e-2, mixture_weight=0.5
+    )
+    for shape in (
+        ShapeSignature(n=50_000, d=512, k=64),
+        ShapeSignature(n=2_000, d=4_096, k=50),
+        ShapeSignature(n=200_000, d=256, k=100),
+    ):
+        expected = min(
+            auto.options,
+            key=lambda s: s.cost(
+                shape.n, shape.d, shape.k, shape.sparsity, shape.machines,
+                auto.cpu_weight, auto.mem_weight, auto.network_weight,
+            ),
+        )
+        choice = auto.choose_solver(shape)
+        assert choice.source == "cold"
+        assert type(choice.chosen) is type(expected)
+
+
+def test_weighted_chunked_restricts_to_streaming_block_solver():
+    """Out-of-core weighted fits can only take the block solver — it is
+    the family's one streaming member."""
+    from keystone_tpu.nodes.learning import WeightedLeastSquaresEstimator
+
+    auto = WeightedLeastSquaresEstimator(
+        block_size=128, num_iter=3, lam=1e-2, mixture_weight=0.5
+    )
+    choice = auto.choose_solver(
+        ShapeSignature(n=500_000, d=512, k=64, chunked=True)
+    )
+    assert choice.label == "BlockWeightedLeastSquaresEstimator"
+    assert choice.costs["PerClassWeightedLeastSquaresEstimator"]["units"] == (
+        float("inf")
+    )
+
+
+def test_seeded_profiles_flip_weighted_borderline(tmp_path):
+    """n=200k, d=256, k=100 is borderline between the block solver and
+    the per-class oracle (~1.3x apart in units). Seeded evidence that the
+    block solver runs slow per unit must flip the pick."""
+    from keystone_tpu.nodes.learning import WeightedLeastSquaresEstimator
+
+    cost.configure(str(tmp_path))
+    store = cost.get_store()
+    shape = ShapeSignature(n=200_000, d=256, k=100)
+    auto = WeightedLeastSquaresEstimator(
+        block_size=128, num_iter=3, lam=1e-2, mixture_weight=0.5
+    )
+    assert auto.choose_solver(shape).label == (
+        "BlockWeightedLeastSquaresEstimator"
+    )
+    _seed_spu(store, "BlockWeightedLeastSquaresEstimator", 5e-6)
+    _seed_spu(store, "PerClassWeightedLeastSquaresEstimator", 1e-6)
+    choice = auto.choose_solver(shape)
+    assert choice.source == "learned"
+    assert choice.label == "PerClassWeightedLeastSquaresEstimator"
+
+
+def test_seeded_profiles_flip_krr_borderline(tmp_path):
+    """n=8000 sits near the crossover between the exact full-kernel
+    Cholesky and the epoch-bounded Gauss-Seidel sweeps (~1.2x apart).
+    Evidence that the iterative solver underperforms its analytic units
+    must flip the pick to the exact solve."""
+    from keystone_tpu.nodes.learning import KernelRidgeEstimator
+
+    cost.configure(str(tmp_path))
+    store = cost.get_store()
+    shape = ShapeSignature(n=8_000, d=128, k=10)
+    auto = KernelRidgeEstimator(
+        gamma=1e-3, lam=1e-2, block_size=512, num_epochs=5
+    )
+    assert auto.choose_solver(shape).label == "KernelRidgeRegression"
+    _seed_spu(store, "KernelRidgeRegression", 4e-6)
+    _seed_spu(store, "ExactKernelRidge", 1e-6)
+    choice = auto.choose_solver(shape)
+    assert choice.source == "learned"
+    assert choice.label == "ExactKernelRidge"
+    # the crossover shape itself is otherwise untouched: small n still
+    # takes the exact solve cold
+    assert auto.choose_solver(
+        ShapeSignature(n=2_000, d=128, k=10)
+    ).label == "ExactKernelRidge"
+
+
+def test_krr_exact_and_gauss_seidel_agree():
+    """The two KRR physical solvers are interchangeable: on a small
+    well-conditioned problem their fitted mappers predict alike (the
+    iterative solver to its convergence tolerance, not bit-exact)."""
+    from keystone_tpu.nodes.learning import (
+        ExactKernelRidge,
+        KernelRidgeRegression,
+    )
+
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((96, 6)).astype(np.float32)
+    W = rng.standard_normal((6, 2)).astype(np.float32)
+    Y = (X @ W + 0.01 * rng.standard_normal((96, 2))).astype(np.float32)
+    args = dict(gamma=0.05, lam=0.5, block_size=32)
+    exact = ExactKernelRidge(**args).fit(Dataset.of(X), Dataset.of(Y))
+    gs = KernelRidgeRegression(num_epochs=60, **args).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    import jax.numpy as jnp
+
+    x = jnp.asarray(X[:16])
+    np.testing.assert_allclose(
+        np.asarray(exact.apply_batch(Dataset.of(x)).to_array()),
+        np.asarray(gs.apply_batch(Dataset.of(x)).to_array()),
+        atol=1e-2,
+    )
+
+
 def test_rule_swaps_streaming_solver_for_chunked_leaf():
     """NodeOptimizationRule must detect the chunked leaf and hand the
     chooser a chunked shape, so the swapped-in solver can stream."""

@@ -1,0 +1,168 @@
+"""Snapshot-able streaming accumulators: chunk-order associativity,
+snapshot isolation, and agreement with direct dense computation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.linalg import GramSolverState, MomentsState, TsqrRState
+
+
+def _data(n=200, d=16, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(n, d)).astype(np.float32) + 0.7
+    y = rng.normal(size=(n, k)).astype(np.float32) - 1.2
+    return A, y
+
+
+def test_gram_state_matches_dense_ridge():
+    """solve(lam) from folded chunks == the centered normal-equations
+    solution computed directly."""
+    A, y = _data()
+    state = GramSolverState()
+    for i in range(0, 200, 64):  # ragged tail: 8 rows
+        state.update(A[i : i + 64], y[i : i + 64])
+    assert state.n == 200 and state.rows_folded == 200
+    W, b, mean = state.solve(0.1)
+
+    Ac = A - A.mean(axis=0)
+    yc = y - y.mean(axis=0)
+    G = Ac.T @ Ac + 0.1 * np.eye(16, dtype=np.float32)
+    W_ref = np.linalg.solve(G.astype(np.float64), (Ac.T @ yc).astype(np.float64))
+    np.testing.assert_allclose(np.asarray(W), W_ref, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(mean), A.mean(axis=0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(b), y.mean(axis=0), atol=1e-5)
+
+
+def test_gram_state_snapshot_isolates_and_zeroes_work_counter():
+    A, y = _data()
+    state = GramSolverState().update(A[:100], y[:100])
+    snap = state.snapshot()
+    assert snap.n == 100 and snap.rows_folded == 0
+    snap.update(A[100:], y[100:])
+    assert snap.rows_folded == 100  # only post-snapshot work counted
+    # the original never saw the second fold
+    assert state.n == 100
+    assert np.max(np.abs(state.gram - snap.gram)) > 1e-3
+
+
+def test_gram_state_merge_is_the_two_chunk_fold():
+    """Merging two independently-built states == folding both chunk
+    ranges into one state. The raw sums are exactly equal; the products
+    are held against each state's own provisional shift (b's differs
+    from the fold's), so they compare after translation through solve —
+    per-path f32 rounding only."""
+    A, y = _data()
+    a = GramSolverState().update(A[:120], y[:120])
+    b = GramSolverState().update(A[120:], y[120:])
+    merged = a.merge(b)
+    whole = GramSolverState().update(A[:120], y[:120]).update(A[120:], y[120:])
+    np.testing.assert_allclose(merged.sum_x, whole.sum_x, atol=1e-5)
+    np.testing.assert_allclose(merged.sum_y, whole.sum_y, atol=1e-5)
+    assert merged.n == whole.n == 200
+    Wm, bm, mm = merged.solve(0.1)
+    Ww, bw, mw = whole.solve(0.1)
+    np.testing.assert_allclose(np.asarray(Wm), np.asarray(Ww), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(mm), np.asarray(mw), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(bm), np.asarray(bw), atol=1e-6)
+
+
+def test_gram_state_merge_into_empty_mutates_in_place():
+    """The per-lane reduce pattern (total = empty; total.merge(p) per
+    partial) must work in place: merging into an empty state adopts the
+    other's sums INTO self and counts the rows as folded work."""
+    A, y = _data()
+    p1 = GramSolverState().update(A[:120], y[:120])
+    p2 = GramSolverState().update(A[120:], y[120:])
+    total = GramSolverState()
+    total.merge(p1)
+    total.merge(p2)
+    assert total.n == 200 and total.rows_folded == 200
+    whole = GramSolverState().update(A[:120], y[:120]).update(A[120:], y[120:])
+    Wt, bt, mt = total.solve(0.1)
+    Ww, bw, mw = whole.solve(0.1)
+    np.testing.assert_allclose(np.asarray(Wt), np.asarray(Ww), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(mt), np.asarray(mw), atol=1e-6)
+    # p1 is isolated from the adopting copy
+    total.update(A[:10], y[:10])
+    assert p1.n == 120
+
+
+def test_gram_state_shape_mismatch_raises():
+    A, y = _data()
+    state = GramSolverState().update(A, y)
+    with pytest.raises(ValueError, match="does not match"):
+        state.update(A[:, :8], y)
+    with pytest.raises(ValueError):
+        GramSolverState().solve(0.1)
+
+
+def test_gram_state_survives_large_offset_means():
+    """mean/std = 1000 at n=50k: raw f32 sums lose the centered signal
+    entirely (σ²/μ² = 1e-6 is below f32 epsilon); the shifted f64
+    accumulation must track the f64 direct solve."""
+    rng = np.random.default_rng(1)
+    n, d, k = 50_000, 8, 2
+    A = (rng.standard_normal((n, d)) * 0.1 + 100.0).astype(np.float32)
+    W0 = rng.standard_normal((d, k)).astype(np.float32)
+    y = ((A - 100.0) @ W0).astype(np.float32)
+    state = GramSolverState()
+    for i in range(0, n, 8192):
+        state.update(A[i : i + 8192], y[i : i + 8192])
+    W, _, _ = state.solve(1e-3)
+    Ac = (A - A.mean(axis=0)).astype(np.float64)
+    yc = (y - y.mean(axis=0)).astype(np.float64)
+    W_ref = np.linalg.solve(Ac.T @ Ac + 1e-3 * np.eye(d), Ac.T @ yc)
+    rel = np.max(np.abs(np.asarray(W, dtype=np.float64) - W_ref)) / np.max(
+        np.abs(W_ref)
+    )
+    assert rel <= 1e-3, rel
+
+
+def test_tsqr_state_resumes_the_fold():
+    """Folding chunks [a, b] then appending c == folding [a, b, c] from
+    scratch == the direct QR of the stacked matrix (R is unique up to
+    signs, which finalize fixes)."""
+    A, _ = _data(n=300, d=12)
+    state = TsqrRState()
+    for i in range(0, 200, 64):  # ragged tail: 8 rows
+        state.update(A[i : min(i + 64, 200)])
+    resumed = state.snapshot()
+    resumed.update(A[200:])
+    scratch = TsqrRState()
+    for i in range(0, 300, 64):
+        scratch.update(A[i : i + 64])
+    np.testing.assert_allclose(
+        np.asarray(resumed.finalize()), np.asarray(scratch.finalize()),
+        atol=1e-4,
+    )
+    R_direct = np.linalg.qr(A, mode="r")
+    R_direct *= np.sign(np.diag(R_direct))[:, None]
+    np.testing.assert_allclose(
+        np.asarray(resumed.finalize()), R_direct, atol=1e-3
+    )
+
+
+def test_moments_state_matches_numpy_and_merges():
+    A, _ = _data(n=257)
+    state = MomentsState()
+    for i in range(0, 257, 50):  # ragged tail: 7 rows
+        state.update(A[i : i + 50])
+    np.testing.assert_allclose(state.mean, A.mean(axis=0), atol=1e-6)
+    np.testing.assert_allclose(state.std(), A.std(axis=0), atol=1e-6)
+
+    left = MomentsState().update(A[:100])
+    right = MomentsState().update(A[100:])
+    left.merge(right)
+    np.testing.assert_allclose(left.mean, A.mean(axis=0), atol=1e-6)
+    np.testing.assert_allclose(left.std(), A.std(axis=0), atol=1e-6)
+
+
+def test_gram_state_device_chunks_accepted():
+    """Device-resident chunks (the staged-scan case) fold identically to
+    host arrays."""
+    A, y = _data(n=64)
+    host = GramSolverState().update(A, y)
+    dev = GramSolverState().update(jnp.asarray(A), jnp.asarray(y))
+    np.testing.assert_allclose(host.gram, dev.gram, atol=1e-5)

@@ -204,6 +204,100 @@ def test_bcd_collectives_per_block_not_per_chunk():
     assert all(c == 0 for c in single)
 
 
+# -- class-weighted least squares ---------------------------------------------
+
+
+def _weighted_problem(n=204, d=16, k=4, seed=3):
+    rng = np.random.default_rng(seed)
+    X = (rng.standard_normal((n, d)) / np.sqrt(n)).astype(np.float32)
+    labels = rng.integers(0, k, size=n)
+    Y = -np.ones((n, k), dtype=np.float32)
+    Y[np.arange(n), labels] = 1.0
+    return X, Y
+
+
+@pytest.mark.parametrize("lanes", [2, 8])
+@pytest.mark.parametrize("num_iter", [1, 2])
+def test_weighted_streaming_lane_parity_ragged(lanes, num_iter):
+    """ROADMAP PR-7 follow-on: the K-lane weighted solve (per-lane
+    cross/Gram/class-sum partials reduced once per block) must match the
+    single-lane scan to <= 1e-6, ragged final chunk included
+    (204 = 5*36 + 24)."""
+    from keystone_tpu.linalg import solve_weighted_streaming
+
+    X, Y = _weighted_problem()
+
+    def scan():
+        return iter([X[i : i + 36] for i in range(0, len(X), 36)])
+
+    kw = dict(
+        block_size=4, num_iter=num_iter, lam=1e-2, mixture_weight=0.25,
+        class_chunk=2,
+    )
+    ws1, b1 = solve_weighted_streaming(scan, jnp.asarray(Y), lanes=1, **kw)
+    wsN, bN = solve_weighted_streaming(scan, jnp.asarray(Y), lanes=lanes, **kw)
+    for a, b in zip(ws1, wsN):
+        assert _maxdiff(a, b) <= TOL
+    assert _maxdiff(b1, bN) <= TOL
+
+
+def test_weighted_collectives_per_block_not_per_chunk():
+    """Halving the chunk size (2x the chunks) must leave the weighted
+    scan's per-block-step collective count unchanged."""
+    from keystone_tpu.linalg import solve_weighted_streaming
+    from keystone_tpu.obs import SCAN_SPAN, Tracer, install
+    from keystone_tpu.obs import tracer as trace_mod
+
+    X, Y = _weighted_problem(n=192)
+
+    def run(chunk):
+        def scan():
+            return iter([X[i : i + chunk] for i in range(0, len(X), chunk)])
+
+        tracer = install(Tracer())
+        try:
+            solve_weighted_streaming(
+                scan, jnp.asarray(Y), block_size=8, num_iter=1, lam=1e-2,
+                mixture_weight=0.25, class_chunk=2, lanes=4,
+            )
+            return [
+                sp.attrs.get("collectives", 0)
+                for sp in tracer.spans()
+                if sp.name == SCAN_SPAN
+                and sp.attrs["label"] == "wls.stream"
+            ]
+        finally:
+            trace_mod.reset()
+
+    coarse, fine = run(48), run(24)
+    assert len(coarse) == len(fine) > 0
+    assert coarse == fine
+
+
+def test_weighted_estimator_streaming_lane_parity(monkeypatch):
+    """Front door: a chunked BlockWeightedLeastSquaresEstimator fit at 8
+    lanes must match the 1-lane fit to <= 1e-6 in predictions."""
+    from keystone_tpu.nodes.learning.weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    X, Y = _weighted_problem(n=208)
+    labels = Dataset.of(jnp.asarray(Y))
+
+    def fit(lanes):
+        monkeypatch.setenv("KEYSTONE_SCAN_LANES", str(lanes))
+        monkeypatch.setenv("KEYSTONE_CHUNK_CACHE_BUDGET", "1")
+        est = BlockWeightedLeastSquaresEstimator(
+            block_size=4, num_iter=1, lam=1e-2, mixture_weight=0.25,
+            class_chunk=2,
+        )
+        return est.fit(ChunkedDataset.from_array(X, 36), labels)
+
+    m1, m8 = fit(1), fit(8)
+    x = jnp.asarray(X[:16])
+    assert _maxdiff(m1.trace_batch(x), m8.trace_batch(x)) <= TOL
+
+
 # -- TSQR ---------------------------------------------------------------------
 
 

@@ -354,3 +354,84 @@ def test_datum_hint_survives_pickle(mnist_fitted):
     clone = serialization.loads(serialization.dumps(fitted))
     assert clone.datum_shape == (784,)
     assert clone.datum_dtype == "float32"
+
+
+# ---------------------------------------------------------------------------
+# hot swap: the publish step of an incremental refit
+# ---------------------------------------------------------------------------
+
+
+def _linear_fitted(scale):
+    return FunctionNode(
+        batch_fn=lambda X, s=scale: X * s, label="scale"
+    ).to_pipeline().fit()
+
+
+def test_swap_serves_new_model_with_no_dropped_requests():
+    """Requests submitted continuously across a swap must ALL resolve —
+    each to either the old or the new model's output, with everything
+    after the swap returns on the new one."""
+    engine = ServingEngine(
+        _linear_fitted(2.0), buckets=(4,), datum_shape=(2,), max_wait_ms=1.0
+    )
+    with engine:
+        stop = [False]
+        results = []
+
+        def hammer():
+            while not stop[0]:
+                results.append(
+                    float(np.asarray(
+                        engine.predict(np.ones(2), timeout=30.0)
+                    ).ravel()[0])
+                )
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(hammer) for _ in range(4)]
+            time.sleep(0.2)
+            warmed = engine.swap(_linear_fitted(3.0))
+            assert warmed == 1  # the one configured bucket, pre-warmed
+            # post-swap: every new submission runs the new model
+            post = float(np.asarray(
+                engine.predict(np.ones(2), timeout=30.0)
+            ).ravel()[0])
+            time.sleep(0.2)
+            stop[0] = True
+            for f in futs:
+                f.result(timeout=30)
+        assert post == 3.0
+        snap = engine.metrics.snapshot()
+
+    # no request was dropped, rejected, or errored across the swap
+    c = snap["counters"]
+    assert c["completed"] == c["submitted"]
+    assert c.get("rejected", 0) == 0 and c.get("failed", 0) == 0
+    assert c["swaps"] == 1
+    # every response is one of the two models' outputs, and both appeared
+    assert set(results) <= {2.0, 3.0}
+    assert 2.0 in results and 3.0 in results
+
+
+def test_swap_rejects_mismatched_datum_shape():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    wrong = FunctionNode(
+        batch_fn=lambda X: X * 1.0, label="id3"
+    ).to_pipeline().fit()
+    wrong.datum_shape = (3,)
+    with pytest.raises(ValueError, match="does not match"):
+        engine.swap(wrong)
+
+
+def test_swap_rejects_batch_coupled_and_closed_engine():
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    coupled = FunctionNode(
+        batch_fn=lambda X: X - X.mean(axis=0), label="batchmean"
+    ).to_pipeline().fit()
+    for node in coupled.graph.nodes:
+        coupled.graph.get_operator(node).batch_coupled = True
+    with pytest.raises(ValueError, match="batch-coupled"):
+        engine.swap(coupled)
+    engine.start()
+    engine.shutdown()
+    with pytest.raises(EngineClosed):
+        engine.swap(_linear_fitted(3.0))

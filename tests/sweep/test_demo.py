@@ -1,0 +1,26 @@
+"""The --sweep-demo CLI path: λ grid as one merged DAG, absorb, hot swap."""
+
+from keystone_tpu.__main__ import main
+
+
+def test_sweep_demo_smoke(capsys):
+    rc = main([
+        "--sweep-demo", "--backend", "cpu",
+        "--grid", "1e-2,1e-1", "--nTrain", "512", "--nAppend", "64",
+        "--dim", "32", "--classes", "4", "--requests", "8",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SWEEP PASS" in out
+    assert "prefix_full_executions=1" in out
+    assert "gram_reuse_solves=2" in out
+    assert "failed=0" in out
+
+
+def test_demo_flag_prefixes_stay_unambiguous():
+    """--serve… and --sweep… abbreviations must route to the right demo;
+    the shared prefix --s matches neither and errors out in argparse."""
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["--s", "--backend", "cpu"])

@@ -1,0 +1,360 @@
+"""GridSweep: a λ/config grid fit as ONE merged DAG — the shared featurize
+prefix executes exactly once, Gram/TSQR families solve their whole λ group
+from one accumulation pass, and every member matches its independently-fit
+counterpart."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LinearMapEstimator
+from keystone_tpu.nodes.learning.linear import (
+    BlockLeastSquaresEstimator,
+    TSQRLeastSquaresEstimator,
+)
+from keystone_tpu.nodes.util import MaxClassifier
+from keystone_tpu.sweep import GridSweep, SweepResult
+from keystone_tpu.sweep.grid import expand_grid
+from keystone_tpu.workflow.transformer import Transformer
+
+LAMS = [1e-3, 1e-2, 1e-1, 1.0]
+
+
+class CountingFeaturize(Transformer):
+    """A featurize stage that counts FULL-SIZE executions (optimizer
+    sampling runs on ~24-row probes and must not trip the gate)."""
+
+    def __init__(self, full_rows: int):
+        self.full_rows = full_rows
+        self.full_calls = 0
+
+    def trace_batch(self, X):
+        if int(X.shape[0]) == self.full_rows:
+            self.full_calls += 1
+        return jnp.tanh(X) * 2.0
+
+
+def _problem(n=600, d=32, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32) + 0.5
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    Y = ((np.tanh(X) * 2.0) @ W + 0.05 * rng.normal(size=(n, k)) + 1.0)
+    return X, Y.astype(np.float32)
+
+
+def _model_W(fitted):
+    ws = [
+        np.concatenate([np.asarray(w) for w in op.xs], axis=0)
+        if hasattr(op, "xs") else np.asarray(op.W)
+        for op in fitted.graph.operators.values()
+        if hasattr(op, "W") or hasattr(op, "xs")
+    ]
+    assert len(ws) == 1
+    return ws[0]
+
+
+# ---------------------------------------------------------------------------
+# grid expansion
+# ---------------------------------------------------------------------------
+
+
+def test_expand_grid_cartesian_deterministic():
+    pts = expand_grid({"lam": [1, 2], "dim": ["a", "b", "c"]})
+    assert len(pts) == 6
+    assert pts[0] == {"lam": 1, "dim": "a"}
+    assert pts[-1] == {"lam": 2, "dim": "c"}
+    # key-then-value order is stable
+    assert pts == expand_grid({"lam": [1, 2], "dim": ["a", "b", "c"]})
+
+
+def test_expand_grid_rejects_empty():
+    with pytest.raises(ValueError):
+        expand_grid({})
+    with pytest.raises(ValueError):
+        expand_grid({"lam": []})
+
+
+# ---------------------------------------------------------------------------
+# the tentpole gates: prefix-once + grouped solves + parity
+# ---------------------------------------------------------------------------
+
+
+def test_lambda_sweep_prefix_executes_once_and_members_match():
+    """The acceptance gate: a G-member λ sweep executes the shared
+    featurize prefix exactly once, reuses one Gram accumulation for all G
+    solves, and every member's model is within 1e-6 of (here: identical
+    to) its independently-fit counterpart."""
+    X, Y = _problem()
+    feat = CountingFeaturize(len(X))
+    prefix = feat.to_pipeline()
+    res = GridSweep(
+        prefix,
+        lambda lam: LinearMapEstimator(lam=lam),
+        {"lam": LAMS},
+        Dataset.of(X),
+        Dataset.of(Y),
+    ).fit()
+
+    assert isinstance(res, SweepResult) and len(res) == len(LAMS)
+    assert feat.full_calls == 1, "shared prefix must execute exactly once"
+    assert res.stats["groups"] == 1
+    assert res.stats["gram_reuse_solves"] == len(LAMS)
+
+    for member in res:
+        lam = member.params["lam"]
+        independent = prefix.and_then(
+            LinearMapEstimator(lam=lam, snapshot=True),
+            Dataset.of(X), Dataset.of(Y),
+        ).fit()
+        dW = np.max(np.abs(_model_W(member.fitted) - _model_W(independent)))
+        assert dW <= 1e-6, (lam, dW)
+
+    # distinct λ produce distinct models (the solves really happened per λ)
+    assert (
+        np.max(np.abs(_model_W(res.members[0].fitted)
+                      - _model_W(res.members[-1].fitted))) > 1e-3
+    )
+
+
+def test_sweep_members_serve_independently():
+    """Extracted members are ordinary FittedPipelines: applying one runs
+    prefix + its model, matching the independent fit's predictions."""
+    X, Y = _problem()
+    prefix = CountingFeaturize(len(X)).to_pipeline()
+    res = GridSweep(
+        prefix, lambda lam: LinearMapEstimator(lam=lam), {"lam": [1e-2, 1e-1]},
+        Dataset.of(X), Dataset.of(Y),
+    ).fit()
+    m = res.fitted_for(lam=1e-1)
+    independent = prefix.and_then(
+        LinearMapEstimator(lam=1e-1, snapshot=True),
+        Dataset.of(X), Dataset.of(Y),
+    ).fit()
+    got = np.asarray(m.apply(Dataset.of(X[:48])).to_array())
+    want = np.asarray(independent.apply(Dataset.of(X[:48])).to_array())
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    with pytest.raises(KeyError):
+        res.fitted_for(lam=123.0)
+
+
+def test_multi_axis_grid_forms_separate_families():
+    """A λ × snapshot grid: the two snapshot settings are different
+    ``grid_family`` keys, so the sweep forms two Gram groups — and every
+    member still matches its independent fit."""
+    X, Y = _problem()
+    res = GridSweep(
+        CountingFeaturize(len(X)).to_pipeline(),
+        lambda lam, snapshot: LinearMapEstimator(lam=lam, snapshot=snapshot),
+        {"lam": [1e-2, 1.0], "snapshot": [False, True]},
+        Dataset.of(X), Dataset.of(Y),
+    ).fit()
+    assert len(res) == 4
+    assert res.stats["groups"] == 2
+    assert res.stats["gram_reuse_solves"] == 4
+    ref = {}
+    for member in res:
+        W = _model_W(member.fitted)
+        lam = member.params["lam"]
+        # same λ, different snapshot setting → same solve
+        if lam in ref:
+            np.testing.assert_allclose(W, ref[lam], atol=1e-6)
+        ref[lam] = W
+
+
+def test_tsqr_family_grid_matches_independent_fits():
+    """The TSQR family folds per-λ √λ·I rows into one shared R factor;
+    members must match independent TSQR fits (same augmented algebra)."""
+    X, Y = _problem()
+    prefix = CountingFeaturize(len(X)).to_pipeline()
+    res = GridSweep(
+        prefix,
+        lambda lam: TSQRLeastSquaresEstimator(lam=lam),
+        {"lam": LAMS},
+        Dataset.of(X), Dataset.of(Y),
+    ).fit()
+    assert res.stats["grouped_solves"].get("tsqr") == len(LAMS)
+    for member in res:
+        independent = prefix.and_then(
+            TSQRLeastSquaresEstimator(lam=member.params["lam"]),
+            Dataset.of(X), Dataset.of(Y),
+        ).fit()
+        dW = np.max(np.abs(_model_W(member.fitted) - _model_W(independent)))
+        assert dW <= 1e-5, (member.params, dW)
+
+
+def test_ungrouped_members_still_share_the_prefix():
+    """Estimators without a grid family (here: cold BCD — its grid hook
+    only engages under warm_start) fit independently, but the merged DAG
+    still executes the featurize prefix once and every member matches its
+    independent fit bit-for-bit (same code path, same featurized input)."""
+    X, Y = _problem(n=512, d=32)
+    feat = CountingFeaturize(len(X))
+    prefix = feat.to_pipeline()
+    res = GridSweep(
+        prefix,
+        lambda lam: BlockLeastSquaresEstimator(16, num_iter=2, lam=lam),
+        {"lam": [1e-2, 1e-1, 1.0]},
+        Dataset.of(X), Dataset.of(Y),
+    ).fit()
+    assert feat.full_calls == 1
+    assert res.stats["groups"] == 0
+    for member in res:
+        independent = prefix.and_then(
+            BlockLeastSquaresEstimator(16, num_iter=2, lam=member.params["lam"]),
+            Dataset.of(X), Dataset.of(Y),
+        ).fit()
+        got = np.asarray(member.fitted.apply(Dataset.of(X[:32])).to_array())
+        want = np.asarray(independent.apply(Dataset.of(X[:32])).to_array())
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_warm_started_bcd_grid():
+    """GridSweep(warm_start=True) groups the BCD members: λ's solve in
+    ascending order, each warm-started from its neighbor. Warm iterates
+    differ from cold ones but descend the same objective — so each member
+    must fit at least as well as its cold counterpart (up to noise)."""
+    X, Y = _problem(n=512, d=32)
+    prefix = CountingFeaturize(len(X)).to_pipeline()
+    res = GridSweep(
+        prefix,
+        lambda lam: BlockLeastSquaresEstimator(16, num_iter=2, lam=lam),
+        {"lam": [1e-2, 1e-1, 1.0]},
+        Dataset.of(X), Dataset.of(Y),
+        warm_start=True,
+    ).fit()
+    assert res.stats["groups"] == 1
+    assert res.stats["warm_starts"] == 2
+    feats = np.tanh(X) * 2.0
+    for member in res:
+        lam = member.params["lam"]
+        cold = prefix.and_then(
+            BlockLeastSquaresEstimator(16, num_iter=2, lam=lam),
+            Dataset.of(X), Dataset.of(Y),
+        ).fit()
+        def objective(fitted):
+            pred = np.asarray(fitted.apply(Dataset.of(X)).to_array())
+            W = _model_W(fitted)
+            return (
+                float(np.sum((pred - Y) ** 2))
+                + lam * float(np.sum(W * W))
+            )
+        assert objective(member.fitted) <= objective(cold) * 1.02, lam
+
+
+def test_chunked_data_sweep_streams_once():
+    """Out-of-core sweep: the Gram family accumulates the chunk stream
+    once for all members, matching chunked independent fits."""
+    X, Y = _problem(n=500)
+    res = GridSweep(
+        None,
+        lambda lam: LinearMapEstimator(lam=lam),
+        {"lam": [1e-2, 1.0]},
+        ChunkedDataset.from_array(X, 128),
+        Dataset.of(Y),
+    ).fit()
+    assert res.stats["gram_reuse_solves"] == 2
+    for member in res:
+        independent = LinearMapEstimator(
+            lam=member.params["lam"], snapshot=True
+        ).with_data(ChunkedDataset.from_array(X, 128), Dataset.of(Y)).fit()
+        dW = np.max(np.abs(_model_W(member.fitted) - _model_W(independent)))
+        assert dW <= 1e-6, (member.params, dW)
+
+
+def test_final_stage_is_appended_to_every_member():
+    X, Y = _problem()
+    res = GridSweep(
+        CountingFeaturize(len(X)).to_pipeline(),
+        lambda lam: LinearMapEstimator(lam=lam),
+        {"lam": [1e-2]},
+        Dataset.of(X), Dataset.of(Y),
+        final=MaxClassifier(),
+    ).fit()
+    out = np.asarray(res.members[0].fitted.apply(Dataset.of(X[:16])).to_array())
+    assert out.shape == (16,)
+    assert np.issubdtype(out.dtype, np.integer)
+
+
+def test_sweep_under_autocaching_optimizer_keeps_prefix_once():
+    """With the budgeted AutoCacheRule active the executor only retains
+    planned nodes across pulls — the sweep must pin the shared prefix so
+    it still executes exactly once."""
+    from keystone_tpu.workflow.env import PipelineEnv
+    from keystone_tpu.workflow.optimizers import AutoCachingOptimizer
+
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    X, Y = _problem()
+    feat = CountingFeaturize(len(X))
+    res = GridSweep(
+        feat.to_pipeline(),
+        lambda lam: LinearMapEstimator(lam=lam),
+        {"lam": LAMS},
+        Dataset.of(X), Dataset.of(Y),
+    ).fit()
+    assert len(res) == len(LAMS)
+    assert feat.full_calls == 1
+    assert res.stats["gram_reuse_solves"] == len(LAMS)
+
+
+def test_second_sweep_plans_with_zero_sampling(tmp_path):
+    """Sweep-aware plan reuse: the merged DAG rides the same cost-model
+    loop as a single fit, so the SECOND run of an identical sweep loads
+    the persisted plan and pays zero sampling executions — with every
+    member still matching the first run's models."""
+    import keystone_tpu.cost as cost
+    from keystone_tpu.workflow.env import PipelineEnv
+    from keystone_tpu.workflow.optimizers import AutoCachingOptimizer
+
+    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
+    cost.configure(str(tmp_path))
+    X, Y = _problem()
+
+    def run():
+        cost.reset_sampling()
+        res = GridSweep(
+            CountingFeaturize(len(X)).to_pipeline(),
+            lambda lam: LinearMapEstimator(lam=lam),
+            {"lam": LAMS},
+            Dataset.of(X), Dataset.of(Y),
+        ).fit()
+        return res, cost.sampling_executions()["total"]
+
+    res1, sampled1 = run()
+    res2, sampled2 = run()
+    assert sampled1 > 0, "the cold sweep should pay sampled profiling"
+    assert sampled2 == 0, f"second sweep sampled {sampled2} executions"
+    for m1, m2 in zip(res1, res2):
+        np.testing.assert_allclose(
+            _model_W(m1.fitted), _model_W(m2.fitted), atol=1e-6
+        )
+    keys = cost.get_store().keys()
+    assert any(k.startswith("plan/") for k in keys)
+
+
+def test_make_estimator_must_return_an_estimator():
+    X, Y = _problem(n=64)
+    sweep = GridSweep(
+        None, lambda lam: MaxClassifier(), {"lam": [0.1]},
+        Dataset.of(X), Dataset.of(Y),
+    )
+    with pytest.raises(TypeError, match="make_estimator"):
+        sweep.fit()
+
+
+def test_sweep_members_carry_absorbable_state():
+    """Every Gram-family sweep member snapshots the shared accumulator
+    with its own λ — any of them can absorb appended chunks later."""
+    X, Y = _problem()
+    res = GridSweep(
+        None, lambda lam: LinearMapEstimator(lam=lam), {"lam": [1e-2, 1.0]},
+        Dataset.of(X), Dataset.of(Y),
+    ).fit()
+    for member in res:
+        nodes = member.fitted.absorbable_nodes()
+        assert len(nodes) == 1
+        state = member.fitted.graph.get_operator(nodes[0]).solver_state
+        assert state.n == len(X)
+        assert state.lam == pytest.approx(member.params["lam"])

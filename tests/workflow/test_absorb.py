@@ -1,0 +1,211 @@
+"""FittedPipeline.absorb: incremental refit that folds appended chunks into
+the saved accumulator state — parity with a from-scratch fit on the
+concatenated data, O(new chunks) work, frozen prefix."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LinearMapEstimator
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def _featurize():
+    return FunctionNode(batch_fn=lambda X: jnp.tanh(X) * 2.0, label="feat")
+
+
+def _problem(n, d=24, k=3, seed=0, offset=1.5):
+    """Nonzero feature AND label means, so centering + intercept carry
+    real information through the refit."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32) + offset
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    Y = ((np.tanh(X) * 2.0) @ W + 0.1 * rng.normal(size=(n, k)) + 3.0)
+    return X, Y.astype(np.float32)
+
+
+def _counting_chunked(arr, chunk_rows, counter):
+    """ChunkedDataset whose factory counts every chunk production."""
+    n = int(arr.shape[0])
+
+    def factory():
+        for i in range(0, n, chunk_rows):
+            counter[0] += 1
+            yield arr[i : i + chunk_rows]
+
+    return ChunkedDataset(factory, n, label=f"counting[{n}]")
+
+
+def _concat_chunked(a, a_rows, b, b_rows):
+    """The concatenated dataset with the SAME chunk boundaries the
+    fit-then-absorb sequence saw — parity against it is exact, not
+    summation-order-limited."""
+    def factory():
+        for i in range(0, int(a.shape[0]), a_rows):
+            yield a[i : i + a_rows]
+        for i in range(0, int(b.shape[0]), b_rows):
+            yield b[i : i + b_rows]
+
+    return ChunkedDataset(
+        factory, int(a.shape[0]) + int(b.shape[0]), label="concat"
+    )
+
+
+def _model_W(fitted):
+    ws = [
+        op for op in fitted.graph.operators.values() if hasattr(op, "W")
+    ]
+    assert len(ws) == 1
+    return ws[0]
+
+
+# ---------------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------------
+
+
+def test_absorb_matches_from_scratch_with_centering_and_ragged_chunks():
+    """The acceptance gate: absorb(new_chunks) ≤ 1e-6 of a from-scratch
+    fit on the concatenated data. Original data ends on a ragged 24-row
+    chunk, the appended stream on a ragged 1-row chunk, and both feature
+    and label means are far from zero."""
+    X, Y = _problem(600)
+    Xn, Yn = _problem(97, seed=1)
+    prefix = _featurize().to_pipeline()
+
+    fitted = prefix.and_then(
+        LinearMapEstimator(lam=1e-2, snapshot=True),
+        ChunkedDataset.from_array(X, 64), Dataset.of(Y),
+    ).fit()
+    updated = fitted.absorb(ChunkedDataset.from_array(Xn, 32), Dataset.of(Yn))
+
+    scratch = prefix.and_then(
+        LinearMapEstimator(lam=1e-2, snapshot=True),
+        _concat_chunked(X, 64, Xn, 32),
+        Dataset.of(np.concatenate([Y, Yn])),
+    ).fit()
+
+    mu, ms = _model_W(updated), _model_W(scratch)
+    assert np.max(np.abs(np.asarray(mu.W) - np.asarray(ms.W))) <= 1e-6
+    assert np.max(np.abs(np.asarray(mu.b) - np.asarray(ms.b))) <= 1e-6
+    assert np.max(
+        np.abs(np.asarray(mu.feature_mean) - np.asarray(ms.feature_mean))
+    ) <= 1e-6
+    # end-to-end predictions agree too
+    got = np.asarray(updated.apply(Dataset.of(Xn[:32])).to_array())
+    want = np.asarray(scratch.apply(Dataset.of(Xn[:32])).to_array())
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_absorb_actually_moves_the_model():
+    """Appending differently-distributed data must change W/b — absorb is
+    a refit, not a no-op."""
+    X, Y = _problem(400)
+    Xn = np.random.default_rng(7).normal(size=(200, 24)).astype(np.float32) - 2.0
+    Yn = np.zeros((200, 3), np.float32)
+    fitted = LinearMapEstimator(lam=1e-2, snapshot=True).with_data(
+        Dataset.of(X), Dataset.of(Y)
+    ).fit()
+    updated = fitted.absorb(Dataset.of(Xn), Dataset.of(Yn))
+    assert np.max(np.abs(
+        np.asarray(_model_W(updated).W) - np.asarray(_model_W(fitted).W)
+    )) > 1e-3
+
+
+def test_sequential_absorbs_compose():
+    """absorb(b) then absorb(c) == from-scratch on a+b+c (matched
+    chunking): the state the second absorb starts from is exactly the
+    first absorb's output state."""
+    X, Y = _problem(300)
+    Xb, Yb = _problem(64, seed=2)
+    Xc, Yc = _problem(50, seed=3)
+    est = lambda: LinearMapEstimator(lam=0.1, snapshot=True)  # noqa: E731
+
+    fitted = est().with_data(
+        ChunkedDataset.from_array(X, 100), Dataset.of(Y)
+    ).fit()
+    twice = fitted.absorb(
+        ChunkedDataset.from_array(Xb, 64), Dataset.of(Yb)
+    ).absorb(ChunkedDataset.from_array(Xc, 50), Dataset.of(Yc))
+
+    def factory():
+        for i in range(0, 300, 100):
+            yield X[i : i + 100]
+        yield Xb
+        yield Xc
+
+    scratch = est().with_data(
+        ChunkedDataset(factory, 414, label="abc"),
+        Dataset.of(np.concatenate([Y, Yb, Yc])),
+    ).fit()
+    assert np.max(np.abs(
+        np.asarray(_model_W(twice).W) - np.asarray(_model_W(scratch).W)
+    )) <= 1e-6
+
+
+def test_absorb_leaves_the_original_pipeline_untouched():
+    X, Y = _problem(300)
+    Xn, Yn = _problem(100, seed=4)
+    fitted = LinearMapEstimator(lam=1e-2, snapshot=True).with_data(
+        Dataset.of(X), Dataset.of(Y)
+    ).fit()
+    W_before = np.asarray(_model_W(fitted).W).copy()
+    state_n = _model_W(fitted).solver_state.n
+    fitted.absorb(Dataset.of(Xn), Dataset.of(Yn))
+    np.testing.assert_array_equal(np.asarray(_model_W(fitted).W), W_before)
+    assert _model_W(fitted).solver_state.n == state_n == 300
+
+
+# ---------------------------------------------------------------------------
+# the work gate: O(new chunks), never a rescan of the original data
+# ---------------------------------------------------------------------------
+
+
+def test_absorb_scans_only_the_appended_chunks():
+    X, Y = _problem(600)
+    Xn, Yn = _problem(97, seed=1)
+    old_count, new_count = [0], [0]
+
+    fitted = _featurize().to_pipeline().and_then(
+        LinearMapEstimator(lam=1e-2, snapshot=True),
+        _counting_chunked(X, 64, old_count), Dataset.of(Y),
+    ).fit()
+    scans_for_fit = old_count[0]
+    assert scans_for_fit >= 10  # 600/64 → 10 chunks, ≥ 1 scan
+
+    updated = fitted.absorb(
+        _counting_chunked(Xn, 32, new_count), Dataset.of(Yn)
+    )
+    assert old_count[0] == scans_for_fit, (
+        "absorb re-scanned the original training data"
+    )
+    assert new_count[0] == 4  # ceil(97/32): exactly one scan of the new data
+    assert _model_W(updated).solver_state.n == 697
+
+
+# ---------------------------------------------------------------------------
+# contract errors
+# ---------------------------------------------------------------------------
+
+
+def test_absorb_without_snapshot_state_raises():
+    X, Y = _problem(200)
+    fitted = LinearMapEstimator(lam=1e-2).with_data(
+        Dataset.of(X), Dataset.of(Y)
+    ).fit()
+    with pytest.raises(ValueError, match="snapshot-able"):
+        fitted.absorb(Dataset.of(X[:10]), Dataset.of(Y[:10]))
+
+
+def test_absorb_row_mismatch_raises():
+    X, Y = _problem(200)
+    fitted = LinearMapEstimator(lam=1e-2, snapshot=True).with_data(
+        Dataset.of(X), Dataset.of(Y)
+    ).fit()
+    with pytest.raises(ValueError):
+        fitted.absorb(
+            ChunkedDataset.from_array(X[:64], 32), Dataset.of(Y[:50])
+        )
