@@ -6,6 +6,11 @@
 # executable cache dir and assert the warm boot paid ZERO pipeline
 # traces — every bucket must load the executable the first boot
 # exported (--expect-zero-compiles makes any warm-boot trace fatal).
+# Finally boot a 2-replica ServingFleet against the same warm cache:
+# still zero steady-state compiles (replicas share one dispatcher +
+# cache dir and pre-warm from the bucket-signature manifest), every
+# replica served batches, and the trace carries per-replica
+# serve.replica spans plus the scheduler's serve.dispatch events.
 # Extra flags pass through to the demo, e.g.:
 #   bin/serve-smoke.sh --requests 128 --buckets 8,32,64
 set -euo pipefail
@@ -20,3 +25,29 @@ echo "== boot 1 (cold: traces + exports every bucket) =="
 "${run[@]}" "$@"
 echo "== boot 2 (warm: must load every bucket, zero traces) =="
 "${run[@]}" --expect-zero-compiles "$@"
+echo "== boot 3 (2-replica fleet, warm: zero traces + per-replica spans) =="
+fleettrace="$cachedir/fleet-trace.json"
+"${run[@]}" --trace "$fleettrace" --replicas 2 --expect-zero-compiles "$@"
+python - "$fleettrace" <<'PY'
+import json, sys
+
+with open(sys.argv[1]) as f:
+    events = json.load(f)["traceEvents"]
+
+def args_of(e):
+    return e.get("args") or {}
+
+replica_spans = [e for e in events if e.get("name") == "serve.replica"]
+dispatches = [e for e in events if e.get("name") == "serve.dispatch"]
+swaps_seen = {args_of(e).get("replica") for e in replica_spans}
+assert replica_spans, "no serve.replica spans in the fleet trace"
+assert dispatches, "no serve.dispatch events in the fleet trace"
+assert {0, 1} <= swaps_seen, f"expected spans from both replicas, got {swaps_seen}"
+for e in dispatches:
+    a = args_of(e)
+    assert "bucket" in a and "occupancy" in a, f"dispatch event missing attrs: {a}"
+print(
+    f"FLEET TRACE OK: {len(replica_spans)} serve.replica span(s) across "
+    f"replicas {sorted(swaps_seen)}, {len(dispatches)} dispatch event(s)"
+)
+PY

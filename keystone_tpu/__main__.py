@@ -166,7 +166,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--serve-demo", action="store_true", dest="serve_demo",
         help="smoke mode: fit a small pipeline and push synthetic traffic "
              "through the serving engine (see keystone_tpu/serving/); "
-             "replaces the pipeline name",
+             "replaces the pipeline name. --replicas N serves from a "
+             "continuous-batching ServingFleet of N workers instead of "
+             "the single-worker engine",
     )
     p.add_argument(
         "--sweep-demo", action="store_true", dest="sweep_demo",

@@ -36,6 +36,7 @@ from .fingerprint import (
     environment_key,
     pipeline_fingerprint,
 )
+from .manifest import exported_signatures, record_export
 
 __all__ = [
     "AotDispatcher",
@@ -45,8 +46,10 @@ __all__ = [
     "configure",
     "entry_key",
     "environment_key",
+    "exported_signatures",
     "get_cache",
     "pipeline_fingerprint",
+    "record_export",
     "reset",
     "signature_of",
 ]

@@ -87,6 +87,13 @@ class AotDispatcher:
     # -- introspection --------------------------------------------------
 
     @property
+    def digest(self) -> str:
+        """The pipeline fingerprint this dispatcher compiles for — the
+        manifest key a booting fleet uses to pre-warm every previously
+        exported signature."""
+        return self._digest
+
+    @property
     def loaded_count(self) -> int:
         """Signatures resolved from the cache (zero traces paid)."""
         return self._loaded
@@ -213,6 +220,11 @@ class AotDispatcher:
                     "created_unix": time.time(),
                 },
             )
+            # index the export in the bucket-signature manifest so a
+            # fresh replica can pre-warm every signature at deploy time
+            from . import manifest as _manifest
+
+            _manifest.record_export(self._cache, self._digest, sig[0], sig[1])
         except Exception:
             logger.warning(
                 "aot: could not persist %s %s — executable still serves "

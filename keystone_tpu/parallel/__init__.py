@@ -9,6 +9,7 @@ from .lanes import (
     reduce_lane_partials,
     scan_lanes,
 )
+from .placement import data_axis_devices, replica_devices
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -30,6 +31,7 @@ __all__ = [
     "MODEL_AXIS",
     "batch_sharding",
     "column_sharding",
+    "data_axis_devices",
     "default_mesh",
     "gather_lane_partials",
     "lane_devices",
@@ -38,6 +40,7 @@ __all__ = [
     "pad_to_multiple",
     "record_scan_collectives",
     "reduce_lane_partials",
+    "replica_devices",
     "replicate",
     "replicated_sharding",
     "scan_lanes",

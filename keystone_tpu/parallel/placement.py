@@ -1,0 +1,42 @@
+"""Replica→device placement for the serving fleet.
+
+Training-side scans shard over the data axis of the active mesh
+(:mod:`~keystone_tpu.parallel.lanes`); the serving fleet pins whole
+replicas the same way: replica ``i`` owns the data-axis device
+``i % n_data`` of the active mesh, so a fleet sized "one replica per
+device" (the default) keeps every chip busy with independent
+micro-batches while the model axis stays available to each replica's
+executable. A 1-device environment yields co-resident replicas — still
+useful on CPU, where the worker threads overlap host-side work (request
+validation, stacking, D2H) with each other's device compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .mesh import default_mesh
+
+
+def data_axis_devices(mesh=None) -> List[Any]:
+    """The device owning each data-axis row of the mesh (model index 0 —
+    same convention as :func:`~keystone_tpu.parallel.lanes.lane_devices`:
+    replica state is data-parallel)."""
+    m = mesh if mesh is not None else default_mesh()
+    if m.devices.ndim >= 2:
+        return list(m.devices[:, 0].flat)
+    return list(m.devices.flat)
+
+
+def replica_devices(
+    n: Optional[int] = None, mesh=None
+) -> List[Any]:
+    """Device for each of ``n`` serving replicas, round-robin over the
+    data axis of the active mesh. ``n=None`` sizes the fleet at one
+    replica per data-axis device — the ISSUE's default shape."""
+    devs = data_axis_devices(mesh)
+    if n is None:
+        n = len(devs)
+    if n < 1:
+        raise ValueError(f"need at least one replica, got {n}")
+    return [devs[i % len(devs)] for i in range(n)]

@@ -13,29 +13,47 @@ layer that amortizes that program across concurrent request traffic:
   bucket shapes so the compiled function traces once per bucket (XLA
   specializes per shape; without bucketing every new batch size pays a
   full recompile under live traffic).
-* :class:`MetricsRegistry` — queue depth, batch occupancy, compile count,
-  and p50/p95/p99 request latency, with a programmatic ``snapshot()`` and
-  periodic INFO logging.
+* :class:`MetricsRegistry` — queue depth, batch occupancy (fleet-wide and
+  per replica), compile count, p50/p95/p99 request latency and queue age,
+  with a programmatic ``snapshot()`` and periodic INFO logging.
+* :class:`ServingFleet` — N :class:`Replica` workers (one per mesh device
+  by default, device-pinned batches) behind one
+  :class:`FleetScheduler`: continuous batching, deadline-aware admission
+  shedding (typed :class:`Shed`), work-stealing rebalance, and
+  fleet-wide zero-downtime hot swap with an optional shadow/canary
+  comparison phase (auto-rollback raises :class:`CanaryMismatch`).
 """
 
 from .batching import BucketPolicy
 from .engine import ServingEngine
 from .errors import (
+    CanaryMismatch,
     DeadlineExceeded,
     EngineClosed,
+    EngineStopped,
     InvalidRequest,
     QueueFull,
     ServingError,
+    Shed,
 )
+from .fleet import ServingFleet
 from .metrics import MetricsRegistry
+from .replica import Replica
+from .scheduler import FleetScheduler
 
 __all__ = [
     "ServingEngine",
+    "ServingFleet",
+    "Replica",
+    "FleetScheduler",
     "BucketPolicy",
     "MetricsRegistry",
     "ServingError",
     "QueueFull",
+    "Shed",
     "DeadlineExceeded",
     "InvalidRequest",
     "EngineClosed",
+    "EngineStopped",
+    "CanaryMismatch",
 ]

@@ -16,6 +16,7 @@ any row-wise chain and are sliced off before results are returned.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
@@ -45,6 +46,10 @@ class BucketPolicy:
             tuple(int(d) for d in datum_shape) if datum_shape is not None else None
         )
         self.dtype = np.dtype(dtype)
+        # guards the lazy shape lock-in: N fleet replica workers may
+        # validate first requests concurrently, and exactly ONE shape may
+        # win — the losers' requests must fail typed, not flip the contract
+        self._shape_lock = threading.Lock()
 
     @property
     def max_size(self) -> int:
@@ -74,8 +79,10 @@ class BucketPolicy:
         except (TypeError, ValueError) as e:
             raise InvalidRequest(f"datum not castable to {self.dtype}: {e}") from e
         if self.datum_shape is None:
-            self.datum_shape = tuple(arr.shape)
-        elif tuple(arr.shape) != self.datum_shape:
+            with self._shape_lock:
+                if self.datum_shape is None:
+                    self.datum_shape = tuple(arr.shape)
+        if tuple(arr.shape) != self.datum_shape:
             raise InvalidRequest(
                 f"datum shape {tuple(arr.shape)} != service shape {self.datum_shape}"
             )

@@ -1,6 +1,8 @@
 """``--serve-demo``: fit a small pipeline, push synthetic traffic through
-the engine, print the metrics snapshot. The smoke path behind
-``bin/serve-smoke.sh`` and the CLI's ``--serve-demo`` flag.
+the engine — or, with ``--replicas N``, through a continuous-batching
+:class:`~keystone_tpu.serving.fleet.ServingFleet` — print the metrics
+snapshot. The smoke path behind ``bin/serve-smoke.sh`` and the CLI's
+``--serve-demo`` flag.
 """
 
 from __future__ import annotations
@@ -60,6 +62,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--lambda", dest="lam", type=float, default=100.0)
     p.add_argument("--nTrain", type=int, default=2048)
     p.add_argument("--requests", type=int, default=64)
+    p.add_argument(
+        "--replicas", type=int, default=1,
+        help="serve from a ServingFleet of N replica workers (continuous "
+             "batching + work stealing) instead of the single-worker "
+             "engine; default 1 = ServingEngine",
+    )
     p.add_argument("--buckets", default="8,32",
                    help="comma-separated static batch-size buckets")
     p.add_argument("--maxQueue", type=int, default=256)
@@ -77,19 +85,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     buckets = tuple(int(b) for b in args.buckets.split(","))
 
     from .engine import ServingEngine
+    from .fleet import ServingFleet
 
     fitted, test_data = build_demo_fitted(
         num_ffts=args.numFFTs, block_size=args.blockSize, lam=args.lam,
         n_train=args.nTrain, n_test=args.requests,
     )
     data = test_data[: args.requests]
-    engine = ServingEngine(
-        fitted,
-        buckets=buckets,
-        datum_shape=data.shape[1:],
-        max_queue=args.maxQueue,
-        max_wait_ms=args.maxWaitMs,
-    )
+    if args.replicas > 1:
+        engine = ServingFleet(
+            fitted,
+            replicas=args.replicas,
+            buckets=buckets,
+            datum_shape=data.shape[1:],
+            max_queue=args.maxQueue,
+            max_wait_ms=args.maxWaitMs,
+        )
+    else:
+        engine = ServingEngine(
+            fitted,
+            buckets=buckets,
+            datum_shape=data.shape[1:],
+            max_queue=args.maxQueue,
+            max_wait_ms=args.maxWaitMs,
+        )
     with engine:
         with ThreadPoolExecutor(max_workers=args.clients) as pool:
             preds = list(pool.map(lambda row: engine.predict(row, timeout=60.0), data))
@@ -102,21 +121,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     occ = snap["batch_occupancy"]["ratio"]
     compiles = c.get("compiles", 0)
     aot_loads = c.get("aot_loads", 0)
+    per_replica = {
+        idx: row["batches"] for idx, row in snap.get("replicas", {}).items()
+    }
     print(
         f"SERVE ok={agree}/{len(data)} compiles={compiles} "
         f"aot_loads={aot_loads} "
         f"batches={c.get('batches', 0)} completed={c.get('completed', 0)} "
         f"occupancy={'n/a' if occ is None else format(occ, '.3f')} "
         f"p50={lat.get('p50', 0):.4f}s p99={lat.get('p99', 0):.4f}s"
+        + (
+            f" replicas={args.replicas} shed={c.get('shed', 0)} "
+            f"steals={c.get('steals', 0)} per_replica_batches={per_replica}"
+            if args.replicas > 1 else ""
+        )
     )
-    ok = (
-        agree == len(data)
-        and c.get("completed", 0) == len(data)
+    ok = agree == len(data) and c.get("completed", 0) == len(data)
+    if args.replicas == 1:
         # every bucket's executable arrived exactly once — traced live or
         # loaded from the AOT cache (policy dedups bucket sizes, so
         # compare against what it kept)
-        and compiles + aot_loads == len(engine.policy.batch_sizes)
-    )
+        ok = ok and compiles + aot_loads == len(engine.policy.batch_sizes)
+    else:
+        # the fleet shares ONE dispatcher across replicas, so the
+        # per-bucket identity is replica-count-independent — but manifest
+        # pre-warm may ADD signatures beyond the buckets, hence >=
+        ok = ok and compiles + aot_loads >= len(engine.policy.batch_sizes)
+        # the continuous-batching fleet must actually spread load: every
+        # replica worker executed at least one micro-batch (work stealing
+        # makes this robust — an idle replica steals from a busy one)
+        if len(per_replica) < args.replicas or any(
+            b < 1 for b in per_replica.values()
+        ):
+            print(f"SERVE FAIL: idle replica (batches {per_replica})")
+            ok = False
     if args.expect_zero_compiles and compiles != 0:
         print(f"SERVE FAIL: warm boot paid {compiles} trace(s), expected 0")
         ok = False

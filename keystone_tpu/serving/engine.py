@@ -19,31 +19,28 @@ while the REST of its micro-batch completes — per-request error isolation.
 
 from __future__ import annotations
 
-import contextlib
 import logging
 import queue
 import threading
 import time
 from concurrent.futures import Future
-from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
 from ..obs.tracer import current as _trace_current
-from ..utils import timing
-from ..workflow.pipeline import FittedPipeline, NotTraceableError
+from ..workflow.pipeline import FittedPipeline
 from .batching import BucketPolicy
-from .errors import DeadlineExceeded, EngineClosed, InvalidRequest, QueueFull
+from .errors import EngineClosed, EngineStopped, QueueFull
 from .metrics import MetricsRegistry
+from .replica import (
+    STOP,
+    Replica,
+    _Request,
+    check_swap_contract,
+    compile_pipeline,
+    serving_contract,
+)
 
 logger = logging.getLogger(__name__)
-
-
-@dataclass
-class _Request:
-    datum: Any
-    deadline: Optional[float]  # time.monotonic() timestamp, or None
-    enqueued: float
-    future: Future = field(default_factory=Future)
 
 
 class ServingEngine:
@@ -82,30 +79,15 @@ class ServingEngine:
         log_interval_s: float = 10.0,
     ):
         self._fitted = fitted
-        # same hazard apply_chunked guards: bucket padding repeats rows, so
-        # a node computing whole-batch statistics would silently fold the
-        # padding into every real request's answer
-        coupled = fitted.batch_coupled_nodes()
-        if coupled:
-            raise ValueError(
-                f"cannot serve a batch-coupled chain ({coupled[0]}): bucket "
-                "padding would corrupt its whole-batch statistics — use "
-                "FittedPipeline.apply() instead"
-            )
         if max_queue < 1:
             # Queue(maxsize=0) would mean UNBOUNDED in python — the exact
             # opposite of the backpressure contract
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         # the per-item serving contract: explicit args win; otherwise fall
         # back to what the pipeline recorded at fit time, so a warm-up-able
-        # engine needs no out-of-band shape plumbing. Shape and dtype fall
-        # back independently — an explicit shape must not discard the
-        # recorded dtype (warming float32 buckets for float64 traffic
-        # would re-trace every bucket under load).
-        if datum_shape is None:
-            datum_shape = getattr(fitted, "datum_shape", None)
-        if dtype is None:
-            dtype = getattr(fitted, "datum_dtype", None) or "float32"
+        # engine needs no out-of-band shape plumbing (replica.py holds the
+        # shared resolution + batch-coupled rejection)
+        datum_shape, dtype = serving_contract(fitted, datum_shape, dtype)
         self._policy = BucketPolicy(buckets, datum_shape, dtype)
         self._metrics = metrics or MetricsRegistry()
         # Strict compile: fail at construction, naming the blocking node,
@@ -122,7 +104,16 @@ class ServingEngine:
         # counts them) — and a miss traces once, then exports for the next
         # process.
         self._compiled_signatures: list = []
-        self._compiled = self._compile_for(fitted)
+        # the worker loop itself lives in replica.py (shared with the
+        # fleet); the engine keeps its classic gather-then-dispatch
+        # batching as this replica's batch source
+        self._replica = Replica(
+            self._compile_for(fitted),
+            self._policy,
+            self._metrics,
+            span_name="serve.microbatch",
+            log_interval_s=log_interval_s,
+        )
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._max_wait = max_wait_ms / 1000.0
         self._log_interval = log_interval_s
@@ -145,64 +136,18 @@ class ServingEngine:
         """Strictly compile ``fitted`` against this engine's private trace
         accounting (the ``compiles`` counter + signature list): the
         constructor's compile path, shared by :meth:`swap` so a replacement
-        model's traces are audited exactly like the original's."""
-        import jax
-
-        fn = fitted.trace_fn()
-        if fn is None:
-            raise NotTraceableError(fitted.untraceable_nodes())
-        signatures = self._compiled_signatures
-        metrics_ref = self._metrics
-
-        def _note_trace(sig):
-            signatures.append(sig)
-            metrics_ref.inc("compiles")
-
-        aot = self._build_aot_dispatcher(fitted, fn, _note_trace)
-        if aot is not None:
-            return aot
-
-        def _traced(x):
-            _note_trace((tuple(x.shape), str(x.dtype)))
-            return fn(x)
-
-        return jax.jit(_traced)
-
-    def _build_aot_dispatcher(self, fitted, fn, note_trace):
-        """The engine's PRIVATE cache-aware compile path (same isolation
-        contract as the private jit). None when no cache is configured or
-        the pipeline cannot be content-keyed — then the legacy jit serves."""
-        from .. import compile as compile_mod
-
-        cache = compile_mod.get_cache()
-        if cache is None:
-            return None
-        try:
-            digest = fitted.fingerprint()
-        except compile_mod.FingerprintError as e:
-            logger.info(
-                "serving: AOT cache skipped (pipeline not fingerprintable): %s", e
-            )
-            return None
-        except Exception:
-            # e.g. RecursionError on self-referential operator state: a
-            # pipeline that serves fine without the cache must not crash
-            # at construction because caching was enabled
-            logger.warning(
-                "serving: AOT cache skipped (fingerprinting failed)",
-                exc_info=True,
-            )
-            return None
-        metrics_ref = self._metrics
-
-        def _note_load(sig):
-            # NOT a compiled signature: no trace was paid for this bucket
-            metrics_ref.inc("aot_loads")
-
-        return compile_mod.AotDispatcher(
-            fn, digest, cache,
-            on_trace=note_trace, on_load=_note_load, label="serving",
+        model's traces are audited exactly like the original's. The jit is
+        PRIVATE to this engine — see :func:`.replica.compile_pipeline`."""
+        return compile_pipeline(
+            fitted,
+            metrics=self._metrics,
+            signatures=self._compiled_signatures,
+            label="serving",
         )
+
+    @property
+    def _compiled(self):
+        return self._replica.compiled
 
     @property
     def metrics(self) -> MetricsRegistry:
@@ -270,7 +215,9 @@ class ServingEngine:
             if warmup or warmup is None:
                 self.warm_up(required=warmup is True)
             self._thread = threading.Thread(
-                target=self._worker_loop, name="keystone-serving-worker",
+                target=self._replica.serve_forever,
+                args=(_GatherSource(self),),
+                name="keystone-serving-worker",
                 daemon=True,
             )
             self._thread.start()
@@ -297,36 +244,8 @@ class ServingEngine:
         False flips cold (the first batch per bucket pays its compile).
         Returns the number of buckets warmed.
         """
-        coupled = fitted.batch_coupled_nodes()
-        if coupled:
-            raise ValueError(
-                f"cannot swap in a batch-coupled chain ({coupled[0]}): "
-                "bucket padding would corrupt its whole-batch statistics"
-            )
-        new_shape = getattr(fitted, "datum_shape", None)
+        check_swap_contract(fitted, self._policy)
         cur_shape = self._policy.datum_shape
-        if (
-            new_shape is not None and cur_shape is not None
-            and tuple(new_shape) != tuple(cur_shape)
-        ):
-            raise ValueError(
-                f"swap datum shape {tuple(new_shape)} does not match the "
-                f"engine's contract {tuple(cur_shape)} — start a new engine "
-                "for a re-shaped model"
-            )
-        import numpy as _np
-
-        new_dtype = getattr(fitted, "datum_dtype", None)
-        if (
-            new_dtype is not None
-            and _np.dtype(new_dtype) != self._policy.dtype
-        ):
-            raise ValueError(
-                f"swap datum dtype {_np.dtype(new_dtype)} does not match "
-                f"the engine's contract {self._policy.dtype} — batches "
-                "would silently cast; start a new engine for a re-typed "
-                "model"
-            )
         with self._lifecycle_lock:
             if self._closed:
                 raise EngineClosed("engine is draining / shut down")
@@ -348,7 +267,7 @@ class ServingEngine:
             # THE swap: one reference store, read once per batch by the
             # worker at dispatch time — each batch runs whole on exactly
             # one executable, never a mix
-            self._compiled = compiled
+            self._replica.flip(compiled)
             self._fitted = fitted
             self._metrics.inc("swaps")
             tracer = _trace_current()
@@ -410,7 +329,7 @@ class ServingEngine:
             except queue.Empty:
                 return
             if r.future.set_running_or_notify_cancel():
-                r.future.set_exception(EngineClosed(reason))
+                r.future.set_exception(EngineStopped(reason))
             self._queue.task_done()
 
     def __enter__(self) -> "ServingEngine":
@@ -436,7 +355,7 @@ class ServingEngine:
         )
         with self._admit_lock:
             if self._closed:
-                raise EngineClosed("engine is draining / shut down")
+                raise EngineStopped("engine is draining / shut down")
             try:
                 self._queue.put_nowait(req)
             except queue.Full:
@@ -466,51 +385,6 @@ class ServingEngine:
             )
         return self.submit(datum, timeout=timeout).result()
 
-    # -- worker ---------------------------------------------------------
-
-    def _worker_loop(self) -> None:
-        while True:
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                if self._stop:
-                    return
-                continue
-            if self._abort:
-                self._fail_and_drain(first)
-                continue
-            batch = [first]
-            gather_until = time.monotonic() + self._max_wait
-            while len(batch) < self._policy.max_size:
-                remaining = gather_until - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._queue.get(timeout=remaining))
-                except queue.Empty:
-                    break
-            try:
-                self._run_batch(batch)
-            except BaseException:  # _run_batch isolates; this is the backstop
-                logger.exception("serving worker: unexpected batch failure")
-                for r in batch:
-                    if not r.future.done():
-                        try:
-                            r.future.set_exception(
-                                EngineClosed("internal batch failure")
-                            )
-                        except Exception:
-                            pass
-            finally:
-                for _ in batch:
-                    self._queue.task_done()
-            try:
-                # user-registered gauges run inside snapshot(); an exception
-                # there must not kill the only worker thread
-                self._metrics.maybe_log(self._log_interval)
-            except Exception:
-                logger.exception("serving worker: metrics logging failed")
-
     def _fail_and_drain(self, first: _Request) -> None:
         """Abortive shutdown: answer everything queued with EngineClosed."""
         reqs = [first]
@@ -524,74 +398,38 @@ class ServingEngine:
                 r.future.set_exception(EngineClosed("engine aborted"))
             self._queue.task_done()
 
-    def _run_batch(self, batch: Sequence[_Request]) -> None:
-        import jax
-        import numpy as np
 
-        now = time.monotonic()
-        live = []
-        for r in batch:
-            if not r.future.set_running_or_notify_cancel():
-                self._metrics.inc("cancelled")
-                continue
-            if r.deadline is not None and now > r.deadline:
-                self._metrics.inc("expired")
-                r.future.set_exception(
-                    DeadlineExceeded(
-                        f"deadline passed {now - r.deadline:.4f}s before batching"
-                    )
-                )
-                continue
-            live.append(r)
+class _GatherSource:
+    """The engine's classic batching policy as a replica batch source:
+    block for the first queued request, then gather more until the
+    largest bucket is full or ``max_wait_ms`` elapses — the original
+    gather-then-dispatch loop, verbatim. (The fleet's continuous-batching
+    scheduler is the other implementation of this protocol.)"""
 
-        valid, rows = [], []
-        for r in live:
-            try:
-                rows.append(self._policy.validate(r.datum))
-                valid.append(r)
-            except InvalidRequest as e:
-                self._metrics.inc("invalid")
-                r.future.set_exception(e)
-        if not valid:
-            return
+    def __init__(self, engine: ServingEngine):
+        self._engine = engine
 
-        bucket = self._policy.bucket_for(len(valid))
-        padded = self._policy.pad(np.stack(rows), bucket)
+    def next_batch(self, replica):
+        e = self._engine
         try:
-            # span name is "serve.microbatch" (not the phase's
-            # "serve.batch") so a merged {name: {seconds, calls, ...}}
-            # export of phases + spans never collides on keys
-            tracer = _trace_current()
-            with contextlib.ExitStack() as stack:
-                sp = (
-                    stack.enter_context(
-                        tracer.span(
-                            "serve.microbatch",
-                            op_type="ServingEngine",
-                            items=len(valid),
-                            bucket=bucket,
-                        )
-                    )
-                    if tracer is not None
-                    else None
-                )
-                with timing.phase("serve.batch") as hold:
-                    out = self._compiled(padded)
-                    hold.append(out)
-                if sp is not None:
-                    sp.sync_on(out)
-            out = jax.device_get(out)  # one D2H fetch for the whole batch
-        except Exception as e:  # batch-level failure → every member errors
-            self._metrics.inc("batch_errors")
-            for r in valid:
-                r.future.set_exception(e)
-            return
+            first = e._queue.get(timeout=0.05)
+        except queue.Empty:
+            return STOP if e._stop else None
+        if e._abort:
+            e._fail_and_drain(first)
+            return None
+        batch = [first]
+        gather_until = time.monotonic() + e._max_wait
+        while len(batch) < e._policy.max_size:
+            remaining = gather_until - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(e._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
 
-        done = time.monotonic()
-        for i, r in enumerate(valid):
-            r.future.set_result(
-                jax.tree_util.tree_map(lambda a: a[i], out)
-            )
-            self._metrics.observe_latency(done - r.enqueued)
-        self._metrics.inc("completed", len(valid))
-        self._metrics.observe_batch(len(valid), bucket)
+    def batch_done(self, batch, replica) -> None:
+        for _ in batch:
+            self._engine._queue.task_done()

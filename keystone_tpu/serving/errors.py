@@ -18,6 +18,16 @@ class QueueFull(ServingError):
     time (backpressure by load-shedding, never unbounded growth)."""
 
 
+class Shed(ServingError):
+    """The request's deadline cannot be met given the fleet's current
+    queue depth and learned batch service time, so it was refused at
+    ADMISSION — before it burned a queue slot and device time only to
+    expire. Distinct from :class:`DeadlineExceeded` (which is the late
+    detection of the same condition at batch time): a shed request never
+    entered the system, so the caller can immediately retry elsewhere or
+    degrade."""
+
+
 class DeadlineExceeded(ServingError):
     """The request's deadline passed while it waited in the queue; it was
     dropped before wasting a batch slot on an answer nobody is waiting
@@ -32,3 +42,24 @@ class InvalidRequest(ServingError):
 
 class EngineClosed(ServingError):
     """Submit after :meth:`ServingEngine.drain` / ``shutdown``."""
+
+
+class EngineStopped(EngineClosed):
+    """The engine/fleet has been stopped: admission observed the closed
+    flag (the admission-vs-shutdown check-and-enqueue is atomic, so a
+    submit either lands before the close and is answered by the drain,
+    or gets this — never a stranded future). Subclasses
+    :class:`EngineClosed` so existing handlers keep working; the distinct
+    type lets fleet callers tell an orderly stop from other close paths."""
+
+
+class CanaryMismatch(ServingError):
+    """A canaried :meth:`ServingFleet.swap` was auto-rolled back: the
+    candidate pipeline's outputs (or latency) diverged from the live
+    model on mirrored traffic. The fleet is still serving the OLD model —
+    nothing was promoted. ``report`` carries the mirrored-batch evidence
+    (batches compared, mismatch details, latency ratio)."""
+
+    def __init__(self, message: str, report: dict = None):
+        super().__init__(message)
+        self.report = report or {}

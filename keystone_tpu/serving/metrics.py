@@ -16,9 +16,17 @@ device-time table as the solvers.
 
 Tracer spans (``keystone_tpu.obs``) land under ``"spans"`` in the SAME
 ``{name: {"seconds", "calls", ...}}`` schema as ``"phases"`` — and the
-engine's span is named ``serve.microbatch`` vs the phase's
-``serve.batch`` — so bench/serve exports can concatenate the two dicts
-without key collisions or shape mismatches.
+engine's span is named ``serve.microbatch`` (fleet replicas:
+``serve.replica``) vs the phase's ``serve.batch`` — so bench/serve
+exports can concatenate the two dicts without key collisions or shape
+mismatches.
+
+Fleet additions: one registry serves all N replica workers —
+``observe_batch(..., replica=i)`` attributes occupancy per replica
+(``snapshot()["replicas"]``), ``observe_queue_age`` tracks time-queued
+quantiles separately from end-to-end latency (p99 queue age grows before
+p99 latency does), and the periodic INFO line carries the shed count and
+canary verdicts next to the classic counters.
 """
 
 from __future__ import annotations
@@ -47,8 +55,12 @@ class MetricsRegistry:
         self._counters: Dict[str, int] = defaultdict(int)
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._latencies: deque = deque(maxlen=latency_window)
+        self._queue_ages: deque = deque(maxlen=latency_window)
         self._batch_items = 0
         self._batch_capacity = 0
+        # replica index -> [items, capacity, batches]: per-replica
+        # occupancy for the fleet (one registry, N replica workers)
+        self._replica_batches: Dict[int, list] = {}
 
     # -- writes ---------------------------------------------------------
 
@@ -66,15 +78,32 @@ class MetricsRegistry:
         with self._lock:
             self._latencies.append(seconds)
 
-    def observe_batch(self, items: int, capacity: int) -> None:
+    def observe_queue_age(self, seconds: float) -> None:
+        """Time one request spent queued before its batch dispatched —
+        the queueing-delay component of latency. p99 queue age is the
+        fleet's early-warning signal: it grows before end-to-end p99
+        does, because it excludes compute."""
+        with self._lock:
+            self._queue_ages.append(seconds)
+
+    def observe_batch(
+        self, items: int, capacity: int, replica: Optional[int] = None
+    ) -> None:
         """One executed micro-batch: ``items`` real rows in a
         ``capacity``-row bucket. The running ratio is batch occupancy —
         how much of each compiled program's work is real traffic vs
-        padding."""
+        padding. ``replica`` additionally attributes the batch to one
+        fleet worker so per-replica occupancy (and a stalled or starved
+        replica) is visible in the snapshot."""
         with self._lock:
             self._counters["batches"] += 1
             self._batch_items += items
             self._batch_capacity += capacity
+            if replica is not None:
+                row = self._replica_batches.setdefault(replica, [0, 0, 0])
+                row[0] += items
+                row[1] += capacity
+                row[2] += 1
 
     # -- reads ----------------------------------------------------------
 
@@ -85,15 +114,25 @@ class MetricsRegistry:
     def latency_quantiles(self) -> Dict[str, float]:
         with self._lock:
             lat = sorted(self._latencies)
-        out: Dict[str, float] = {"count": len(lat)}
-        if not lat:
+        return self._quantiles(lat)
+
+    def queue_age_quantiles(self) -> Dict[str, float]:
+        """p50/p95/p99 of time-spent-queued, same schema as latency."""
+        with self._lock:
+            ages = sorted(self._queue_ages)
+        return self._quantiles(ages)
+
+    @staticmethod
+    def _quantiles(vals: list) -> Dict[str, float]:
+        out: Dict[str, float] = {"count": len(vals)}
+        if not vals:
             return out
-        out["mean"] = sum(lat) / len(lat)
+        out["mean"] = sum(vals) / len(vals)
         for q in _QUANTILES:
             # nearest-rank: ceil(q*n)-1, clamped (int(q*n) alone is biased
             # one rank high — p99 of a full window would report the max)
-            idx = min(len(lat) - 1, max(0, math.ceil(q * len(lat)) - 1))
-            out[f"p{int(q * 100)}"] = lat[idx]
+            idx = min(len(vals) - 1, max(0, math.ceil(q * len(vals)) - 1))
+            out[f"p{int(q * 100)}"] = vals[idx]
         return out
 
     def snapshot(self) -> Dict[str, object]:
@@ -103,6 +142,9 @@ class MetricsRegistry:
             counters = dict(self._counters)
             gauges = list(self._gauges.items())
             items, capacity = self._batch_items, self._batch_capacity
+            replicas = {
+                idx: list(row) for idx, row in self._replica_batches.items()
+            }
         return {
             "name": self.name,
             "counters": counters,
@@ -112,7 +154,17 @@ class MetricsRegistry:
                 "capacity": capacity,
                 "ratio": (items / capacity) if capacity else None,
             },
+            "replicas": {
+                str(idx): {
+                    "items": row[0],
+                    "capacity": row[1],
+                    "batches": row[2],
+                    "occupancy": (row[0] / row[1]) if row[1] else None,
+                }
+                for idx, row in sorted(replicas.items())
+            },
             "latency": self.latency_quantiles(),
+            "queue_age": self.queue_age_quantiles(),
             "phases": timing.snapshot(prefix="serve."),
             "spans": self._span_summary(),
         }
@@ -142,14 +194,25 @@ class MetricsRegistry:
             return False
         snap = self.snapshot()
         lat = snap["latency"]
+        age = snap["queue_age"]
         occ = snap["batch_occupancy"]["ratio"]
+        c = snap["counters"]
+        canary = (
+            f"{c.get('canary_pass', 0)}pass/{c.get('canary_fail', 0)}fail"
+            if c.get("canary_pass") or c.get("canary_fail")
+            else None
+        )
         logger.info(
-            "%s: counters=%s queue=%s occupancy=%s p50=%s p99=%s",
+            "%s: counters=%s queue=%s occupancy=%s shed=%s canary=%s "
+            "p50=%s p99=%s queue_age_p99=%s",
             self.name,
-            snap["counters"],
+            c,
             snap["gauges"].get("queue_depth"),
             None if occ is None else round(occ, 3),
+            c.get("shed", 0),
+            canary,
             round(lat["p50"], 4) if "p50" in lat else None,
             round(lat["p99"], 4) if "p99" in lat else None,
+            round(age["p99"], 4) if "p99" in age else None,
         )
         return True

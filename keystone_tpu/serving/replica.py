@@ -1,0 +1,392 @@
+"""The reusable serving worker: one replica = one device-pinned executable
+behind one batch loop.
+
+:class:`ServingEngine` (one replica, gather-then-dispatch batching) and
+:class:`~keystone_tpu.serving.fleet.ServingFleet` (N replicas behind a
+shared continuous-batching scheduler) both run THIS worker; what differs
+between them is only the :class:`BatchSource` that decides which requests
+form the next micro-batch. The replica owns the parts every serving
+topology shares:
+
+* the **executable reference** — read once per batch at dispatch time, so
+  a hot swap is one atomic store and every micro-batch runs whole on
+  exactly one executable, never a mix;
+* **device pinning** — a replica constructed with a device stages each
+  padded batch onto it before dispatch, so N replicas spread over the
+  mesh keep every chip busy (placement comes from
+  :func:`keystone_tpu.parallel.placement.replica_devices`);
+* the **batch execution discipline** — deadline expiry, per-request
+  validation isolation, one D2H fetch per batch, per-request result
+  distribution, queue-age/latency/occupancy metrics, and the
+  ``serve.replica``/``serve.microbatch`` span;
+* the **shadow hook** — when a canary swap is in flight, the fleet
+  installs a shadow that mirrors completed batches through the candidate
+  executable AFTER results are distributed, so comparison never adds
+  latency to live requests.
+
+The compile path (:func:`compile_pipeline`) is shared too: strict trace
+accounting plus the AOT executable cache ride identically under an
+engine, a fleet replica, or a swap candidate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..obs.tracer import current as _trace_current
+from ..utils import timing
+from ..workflow.pipeline import FittedPipeline, NotTraceableError
+from .batching import BucketPolicy
+from .errors import DeadlineExceeded, EngineStopped, InvalidRequest
+from .metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+#: sentinel a BatchSource returns to stop the replica's loop
+STOP = object()
+
+
+@dataclass
+class _Request:
+    datum: Any
+    deadline: Optional[float]  # time.monotonic() timestamp, or None
+    enqueued: float
+    future: Future = field(default_factory=Future)
+
+
+# ---------------------------------------------------------------------------
+# shared compile path
+# ---------------------------------------------------------------------------
+
+
+def compile_pipeline(
+    fitted: FittedPipeline,
+    *,
+    metrics: MetricsRegistry,
+    signatures: list,
+    label: str = "serving",
+) -> Callable:
+    """Strictly compile ``fitted`` against private trace accounting: every
+    XLA trace paid appends its ``(shape, dtype)`` to ``signatures`` and
+    bumps the ``compiles`` counter; with an AOT executable cache
+    configured, each signature first tries to LOAD a previously exported
+    executable (``aot_loads`` counts them) so a warm boot pays zero
+    traces. Raises :class:`NotTraceableError` for an unjittable chain —
+    at construction, never per-request under traffic."""
+    import jax
+
+    fn = fitted.trace_fn()
+    if fn is None:
+        raise NotTraceableError(fitted.untraceable_nodes())
+
+    def _note_trace(sig):
+        signatures.append(sig)
+        metrics.inc("compiles")
+
+    aot = _build_aot_dispatcher(fitted, fn, _note_trace, metrics, label)
+    if aot is not None:
+        return aot
+
+    def _traced(x):
+        _note_trace((tuple(x.shape), str(x.dtype)))
+        return fn(x)
+
+    return jax.jit(_traced)
+
+
+def _build_aot_dispatcher(fitted, fn, note_trace, metrics, label):
+    """The cache-aware compile path (same isolation contract as the
+    private jit). None when no cache is configured or the pipeline cannot
+    be content-keyed — then the legacy jit serves."""
+    from .. import compile as compile_mod
+
+    cache = compile_mod.get_cache()
+    if cache is None:
+        return None
+    try:
+        digest = fitted.fingerprint()
+    except compile_mod.FingerprintError as e:
+        logger.info(
+            "serving: AOT cache skipped (pipeline not fingerprintable): %s", e
+        )
+        return None
+    except Exception:
+        # e.g. RecursionError on self-referential operator state: a
+        # pipeline that serves fine without the cache must not crash
+        # at construction because caching was enabled
+        logger.warning(
+            "serving: AOT cache skipped (fingerprinting failed)",
+            exc_info=True,
+        )
+        return None
+
+    def _note_load(sig):
+        # NOT a compiled signature: no trace was paid for this bucket
+        metrics.inc("aot_loads")
+
+    return compile_mod.AotDispatcher(
+        fn, digest, cache,
+        on_trace=note_trace, on_load=_note_load, label=label,
+    )
+
+
+def serving_contract(
+    fitted: FittedPipeline,
+    datum_shape: Optional[Sequence[int]],
+    dtype: Any,
+    *,
+    verb: str = "serve",
+):
+    """Resolve the per-item (shape, dtype) contract and reject chains the
+    bucket policy would silently corrupt. Explicit args win; otherwise the
+    contract recorded on the fitted pipeline at fit time is used."""
+    # same hazard apply_chunked guards: bucket padding repeats rows, so a
+    # node computing whole-batch statistics would silently fold the
+    # padding into every real request's answer
+    coupled = fitted.batch_coupled_nodes()
+    if coupled:
+        raise ValueError(
+            f"cannot {verb} a batch-coupled chain ({coupled[0]}): bucket "
+            "padding would corrupt its whole-batch statistics — use "
+            "FittedPipeline.apply() instead"
+        )
+    # shape and dtype fall back independently — an explicit shape must not
+    # discard the recorded dtype (warming float32 buckets for float64
+    # traffic would re-trace every bucket under load)
+    if datum_shape is None:
+        datum_shape = getattr(fitted, "datum_shape", None)
+    if dtype is None:
+        dtype = getattr(fitted, "datum_dtype", None) or "float32"
+    return datum_shape, dtype
+
+
+def check_swap_contract(fitted: FittedPipeline, policy: BucketPolicy) -> None:
+    """A replacement model must satisfy the live datum contract (shape +
+    dtype) and must not be batch-coupled — re-bucketing or re-shaping a
+    live engine/fleet is a restart, not a swap."""
+    import numpy as _np
+
+    coupled = fitted.batch_coupled_nodes()
+    if coupled:
+        raise ValueError(
+            f"cannot swap in a batch-coupled chain ({coupled[0]}): "
+            "bucket padding would corrupt its whole-batch statistics"
+        )
+    new_shape = getattr(fitted, "datum_shape", None)
+    cur_shape = policy.datum_shape
+    if (
+        new_shape is not None and cur_shape is not None
+        and tuple(new_shape) != tuple(cur_shape)
+    ):
+        raise ValueError(
+            f"swap datum shape {tuple(new_shape)} does not match the "
+            f"engine's contract {tuple(cur_shape)} — start a new engine "
+            "for a re-shaped model"
+        )
+    new_dtype = getattr(fitted, "datum_dtype", None)
+    if new_dtype is not None and _np.dtype(new_dtype) != policy.dtype:
+        raise ValueError(
+            f"swap datum dtype {_np.dtype(new_dtype)} does not match "
+            f"the engine's contract {policy.dtype} — batches would "
+            "silently cast; start a new engine for a re-typed model"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the replica worker
+# ---------------------------------------------------------------------------
+
+
+class Replica:
+    """One serving worker: a compiled-executable reference, an optional
+    pinned device, and the batch loop. Batching POLICY lives in the
+    ``source`` handed to :meth:`serve_forever` — the replica only
+    executes what the source forms."""
+
+    def __init__(
+        self,
+        compiled: Callable,
+        policy: BucketPolicy,
+        metrics: MetricsRegistry,
+        *,
+        index: Optional[int] = None,
+        device: Any = None,
+        span_name: str = "serve.replica",
+        log_interval_s: float = 10.0,
+    ):
+        #: fleet position, or None for a single-worker topology (the
+        #: engine) — None keeps per-replica metrics rows and span attrs
+        #: out of snapshots that never had them
+        self.index = index
+        self.device = device
+        self._compiled = compiled
+        self._policy = policy
+        self._metrics = metrics
+        self._span_name = span_name
+        self._log_interval = log_interval_s
+        self._shadow: Optional[Callable] = None
+        #: wall seconds of the last executed batch (compute + D2H), read
+        #: by the fleet scheduler to learn its service-time estimate
+        self.last_exec_seconds: Optional[float] = None
+
+    @property
+    def compiled(self) -> Callable:
+        return self._compiled
+
+    def flip(self, compiled: Callable) -> None:
+        """THE swap: one reference store, read once per batch at dispatch
+        time — each batch runs whole on exactly one executable."""
+        self._compiled = compiled
+
+    def set_shadow(self, shadow: Optional[Callable]) -> None:
+        """Install (or clear) the canary mirror: ``shadow(replica, padded,
+        primary_out, n_valid, bucket)`` runs after a batch's results are
+        distributed, so mirroring never delays live responses."""
+        self._shadow = shadow
+
+    # -- the loop -------------------------------------------------------
+
+    def serve_forever(self, source) -> None:
+        """Run batches from ``source`` until it returns :data:`STOP`.
+        ``source.next_batch(replica)`` returns a request list, None (poll
+        again), or STOP; ``source.batch_done(batch, replica)`` runs after
+        every batch, exception or not (queue accounting)."""
+        while True:
+            batch = source.next_batch(self)
+            if batch is STOP:
+                return
+            if batch:
+                try:
+                    self.run_batch(batch)
+                except BaseException:  # run_batch isolates; the backstop
+                    logger.exception(
+                        "serving replica %s: unexpected batch failure",
+                        self.index,
+                    )
+                    for r in batch:
+                        if not r.future.done():
+                            try:
+                                r.future.set_exception(
+                                    EngineStopped("internal batch failure")
+                                )
+                            except Exception:
+                                pass
+                finally:
+                    source.batch_done(batch, self)
+            try:
+                # user-registered gauges run inside snapshot(); an
+                # exception there must not kill a worker thread
+                self._metrics.maybe_log(self._log_interval)
+            except Exception:
+                logger.exception("serving replica: metrics logging failed")
+
+    # -- batch execution ------------------------------------------------
+
+    def run_batch(self, batch: Sequence[_Request]) -> int:
+        """Execute one micro-batch through the current executable on this
+        replica's device. Returns the number of requests answered with a
+        result."""
+        import contextlib
+
+        import jax
+        import numpy as np
+
+        # cleared up front: a batch that never executes (all expired, all
+        # invalid, execution error) must not leave the PREVIOUS batch's
+        # duration for the scheduler to re-fold into its service EWMA
+        self.last_exec_seconds = None
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if not r.future.set_running_or_notify_cancel():
+                self._metrics.inc("cancelled")
+                continue
+            if r.deadline is not None and now > r.deadline:
+                self._metrics.inc("expired")
+                r.future.set_exception(
+                    DeadlineExceeded(
+                        f"deadline passed {now - r.deadline:.4f}s before batching"
+                    )
+                )
+                continue
+            self._metrics.observe_queue_age(now - r.enqueued)
+            live.append(r)
+
+        valid, rows = [], []
+        for r in live:
+            try:
+                rows.append(self._policy.validate(r.datum))
+                valid.append(r)
+            except InvalidRequest as e:
+                self._metrics.inc("invalid")
+                r.future.set_exception(e)
+        if not valid:
+            return 0
+
+        bucket = self._policy.bucket_for(len(valid))
+        padded = self._policy.pad(np.stack(rows), bucket)
+        if self.device is not None:
+            # pin the batch (and so the executable) to this replica's
+            # device — N replicas keep N chips busy instead of letting
+            # XLA park every dispatch on the default device
+            padded = jax.device_put(padded, self.device)
+        compiled = self._compiled  # one read: the whole batch runs on it
+        t0 = time.perf_counter()
+        try:
+            # span name differs from the phase's "serve.batch" so a merged
+            # {name: {seconds, calls, ...}} export of phases + spans never
+            # collides on keys
+            tracer = _trace_current()
+            span_attrs = {"items": len(valid), "bucket": bucket}
+            if self.index is not None:
+                span_attrs["replica"] = self.index
+            with contextlib.ExitStack() as stack:
+                sp = (
+                    stack.enter_context(
+                        tracer.span(
+                            self._span_name,
+                            op_type="Replica",
+                            **span_attrs,
+                        )
+                    )
+                    if tracer is not None
+                    else None
+                )
+                with timing.phase("serve.batch") as hold:
+                    out = compiled(padded)
+                    hold.append(out)
+                if sp is not None:
+                    sp.sync_on(out)
+            out = jax.device_get(out)  # one D2H fetch for the whole batch
+        except Exception as e:  # batch-level failure → every member errors
+            self._metrics.inc("batch_errors")
+            for r in valid:
+                r.future.set_exception(e)
+            return 0
+        self.last_exec_seconds = time.perf_counter() - t0
+
+        done = time.monotonic()
+        for i, r in enumerate(valid):
+            r.future.set_result(
+                jax.tree_util.tree_map(lambda a: a[i], out)
+            )
+            self._metrics.observe_latency(done - r.enqueued)
+        self._metrics.inc("completed", len(valid))
+        self._metrics.observe_batch(len(valid), bucket, replica=self.index)
+
+        shadow = self._shadow
+        if shadow is not None:
+            # canary mirroring rides AFTER result distribution: the
+            # candidate's cost lands on the worker, never on live latency
+            try:
+                shadow(self, padded, out, len(valid), bucket)
+            except Exception:
+                logger.exception(
+                    "serving replica %d: canary shadow failed", self.index
+                )
+        return len(valid)

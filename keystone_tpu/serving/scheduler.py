@@ -1,0 +1,316 @@
+"""The fleet's admission surface and continuous-batching dispatcher.
+
+One :class:`FleetScheduler` sits between N submitter threads and N
+:class:`~keystone_tpu.serving.replica.Replica` workers. It replaces the
+single engine's gather-then-dispatch loop with **continuous batching**:
+a replica that frees up immediately starts forming its next micro-batch
+from whatever is queued NOW, and requests that arrive while the batch is
+forming join it — admission never waits for a batch boundary, and a
+batch never waits for a worker.
+
+Three disciplines, all under one lock (two replicas on two shared vCPUs
+do not need finer granularity; the hold times are microseconds):
+
+* **Deadline-aware admission.** A request whose deadline cannot be met —
+  ``now + estimated_wait > deadline``, where the estimate is the learned
+  EWMA of batch service time scaled by the queue depth ahead of the
+  request — is refused with a typed :class:`Shed` BEFORE it occupies a
+  queue slot or device time. Shedding at admission is strictly better
+  than the engine's expiry-at-batch-time (which still runs the queue
+  ahead of the doomed request); the fleet keeps both: admission sheds
+  what it can predict, the replica expires what it could not. With no
+  service evidence yet the scheduler never sheds (it cannot justify
+  refusing work it knows nothing about).
+
+* **Occupancy-maximizing dispatch.** A free replica pops its queue and
+  keeps gathering until the forming batch exactly fills its bucket
+  (occupancy 1.0), the ``max_wait`` window closes, or the tightest
+  deadline in the batch says further waiting would expire it —
+  whichever comes first. That picks the largest bucket the traffic and
+  the deadlines allow, instead of always padding to whatever happened to
+  be queued.
+
+* **Work stealing.** Admission places each request on the shallowest
+  per-replica queue, but replicas drain at different rates (a 64-bucket
+  batch on one, singles on another). A replica whose own queue is empty
+  steals the newest half of the deepest peer's queue — the victim keeps
+  its oldest (tightest-deadline) work, the thief takes the back of the
+  line — so one stalled replica's bucket mix cannot idle the rest of the
+  fleet.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from ..obs.tracer import current as _trace_current
+from .batching import BucketPolicy
+from .errors import EngineStopped, QueueFull, Shed
+from .metrics import MetricsRegistry
+from .replica import STOP, _Request
+
+logger = logging.getLogger(__name__)
+
+#: EWMA smoothing for the learned batch service time: heavy enough to
+#: follow a swap to a slower model within a few batches, light enough
+#: that one straggler batch does not triple the shed threshold
+_SERVICE_ALPHA = 0.3
+
+
+class FleetScheduler:
+    """Shared admission queue + per-replica run queues for N replicas."""
+
+    def __init__(
+        self,
+        n_replicas: int,
+        policy: BucketPolicy,
+        metrics: MetricsRegistry,
+        *,
+        max_queue: int = 1024,
+        max_wait_ms: float = 2.0,
+        steal: bool = True,
+    ):
+        if n_replicas < 1:
+            raise ValueError(f"need at least one replica, got {n_replicas}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._n = n_replicas
+        self._policy = policy
+        self._metrics = metrics
+        self._max_queue = max_queue
+        self._max_wait = max_wait_ms / 1000.0
+        self._steal = steal
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: List[deque] = [deque() for _ in range(n_replicas)]
+        self._depth = 0  # total queued across all replica queues
+        self._in_flight = 0  # batches handed to replicas, not yet done
+        self._closed = False  # no further admission
+        self._stop = False  # workers should exit
+        self._service_ewma: Optional[float] = None
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    @property
+    def service_estimate(self) -> Optional[float]:
+        """Learned seconds per micro-batch (EWMA), None before evidence."""
+        return self._service_ewma
+
+    def queue_depths(self) -> List[int]:
+        with self._lock:
+            return [len(q) for q in self._queues]
+
+    # -- service-time learning -------------------------------------------
+
+    def observe_service(self, seconds: float) -> None:
+        """Fold one measured batch execution into the service EWMA (also
+        the seam tests and benches use to seed a known estimate)."""
+        prev = self._service_ewma
+        self._service_ewma = (
+            seconds if prev is None
+            else prev + _SERVICE_ALPHA * (seconds - prev)
+        )
+
+    def estimated_wait(self) -> float:
+        """Deterministic completion estimate for a request admitted NOW:
+        its own batch's service time plus the whole batches already
+        queued ahead of it across the fleet. Zero before any evidence —
+        a cold scheduler must not shed traffic it cannot price."""
+        s = self._service_ewma
+        if s is None:
+            return 0.0
+        capacity = self._n * self._policy.max_size
+        return s * (1 + self._depth // capacity)
+
+    # -- admission -------------------------------------------------------
+
+    def admit(self, req: _Request) -> None:
+        """Place one request, or raise typed: :class:`EngineStopped` after
+        close, :class:`QueueFull` at capacity, :class:`Shed` when the
+        deadline is unmeetable. The closed-check and the enqueue are one
+        atomic step — a request either lands before the close (and is
+        answered by the drain) or gets the typed error, never stranded."""
+        with self._cond:
+            if self._closed:
+                raise EngineStopped("fleet is draining / shut down")
+            if self._depth >= self._max_queue:
+                self._metrics.inc("rejected")
+                raise QueueFull(
+                    f"admission queue at capacity ({self._max_queue})"
+                )
+            if req.deadline is not None:
+                est = self.estimated_wait()
+                if time.monotonic() + est > req.deadline:
+                    self._metrics.inc("shed")
+                    raise Shed(
+                        f"deadline unmeetable at admission: estimated wait "
+                        f"{est:.4f}s exceeds the request's "
+                        f"{max(req.deadline - time.monotonic(), 0):.4f}s budget"
+                    )
+            # shallowest queue: depth-balanced placement; drain-rate
+            # imbalance is work-stealing's job, not admission's
+            target = min(range(self._n), key=lambda i: len(self._queues[i]))
+            self._queues[target].append(req)
+            self._depth += 1
+            # counted here, under the lock, so a snapshot can never
+            # observe a request completed before it was submitted
+            self._metrics.inc("submitted")
+            self._cond.notify_all()
+
+    # -- dispatch (replica batch source protocol) ------------------------
+
+    def next_batch(self, replica):
+        """Form the next micro-batch for ``replica`` — continuous
+        batching: start from its own queue (stealing when empty), then
+        keep admitting arrivals into the forming batch until the bucket
+        is exactly full, ``max_wait`` closes, or the tightest deadline
+        forces dispatch."""
+        t0 = time.monotonic()
+        with self._cond:
+            while True:
+                if self._stop:
+                    return STOP
+                stolen = self._maybe_steal(replica.index)
+                own = self._queues[replica.index]
+                if own:
+                    break
+                # idle (including the drained-and-closed case): poll so
+                # the final STOP is observed promptly
+                self._cond.wait(timeout=0.05)
+            batch = self._gather(replica.index)
+            self._in_flight += 1
+        tracer = _trace_current()
+        if tracer is not None:
+            bucket = self._policy.bucket_for(len(batch))
+            tracer.instant(
+                "serve.dispatch",
+                op_type="FleetScheduler",
+                replica=replica.index,
+                items=len(batch),
+                bucket=bucket,
+                occupancy=round(len(batch) / bucket, 3),
+                stolen=stolen,
+                waited_ms=round((time.monotonic() - t0) * 1e3, 3),
+                queue_depth=self._depth,
+            )
+        return batch
+
+    def batch_done(self, batch, replica) -> None:
+        exec_s = replica.last_exec_seconds
+        with self._cond:
+            self._in_flight -= 1
+            if exec_s is not None:
+                self.observe_service(exec_s)
+            self._cond.notify_all()
+
+    def _gather(self, index: int) -> List[_Request]:
+        """Pop the forming batch from queue ``index`` (lock held). Waits
+        for further arrivals only while (a) the forming batch does not
+        yet fill its bucket exactly, (b) the max-wait window is open, and
+        (c) every gathered deadline still affords the wait."""
+        own = self._queues[index]
+        batch = [own.popleft()]
+        self._depth -= 1
+        gather_until = time.monotonic() + self._max_wait
+        while len(batch) < self._policy.max_size:
+            while own and len(batch) < self._policy.max_size:
+                batch.append(own.popleft())
+                self._depth -= 1
+            bucket = self._policy.bucket_for(len(batch))
+            if len(batch) == bucket:
+                break  # exactly full: occupancy 1.0, nothing to wait for
+            now = time.monotonic()
+            wait_budget = gather_until - now
+            # the service estimate is how long the batch will take once
+            # dispatched; waiting may only consume slack beyond that
+            exec_s = self._service_ewma or 0.0
+            for r in batch:
+                if r.deadline is not None:
+                    wait_budget = min(
+                        wait_budget, r.deadline - now - exec_s
+                    )
+            if wait_budget <= 0:
+                break
+            if not self._cond.wait(timeout=wait_budget):
+                # window closed with no arrival: dispatch what we have
+                if not own:
+                    break
+        return batch
+
+    def _maybe_steal(self, index: int) -> int:
+        """With queue ``index`` empty, move the newest half of the deepest
+        peer queue over (lock held). Returns requests moved."""
+        if not self._steal or self._queues[index]:
+            return 0
+        victim = max(
+            (i for i in range(self._n) if i != index),
+            key=lambda i: len(self._queues[i]),
+            default=None,
+        )
+        if victim is None or not self._queues[victim]:
+            return 0
+        vq = self._queues[victim]
+        take = len(vq) // 2 or 1
+        # steal from the BACK: the victim keeps its oldest (tightest-
+        # deadline) requests in FIFO order; the thief takes the newest
+        moved = [vq.pop() for _ in range(take)]
+        self._queues[index].extend(reversed(moved))
+        self._metrics.inc("steals", take)
+        return take
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop admission (submits now raise EngineStopped). Queued and
+        in-flight work keeps draining."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued request has been dispatched AND every
+        in-flight batch has completed. True on idle, False on timeout."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        with self._cond:
+            while self._depth > 0 or self._in_flight > 0:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining if remaining else 0.1)
+            return True
+
+    def stop(self) -> None:
+        """Tell every worker's next ``next_batch`` to return STOP."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+
+    def fail_remaining(self, reason: str = "fleet is shut down") -> int:
+        """Answer everything still queued with :class:`EngineStopped`
+        (the abortive-shutdown path and the post-join sweep). Returns
+        requests failed."""
+        with self._cond:
+            remaining: List[_Request] = []
+            for q in self._queues:
+                remaining.extend(q)
+                q.clear()
+            self._depth = 0
+            self._cond.notify_all()
+        for r in remaining:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(EngineStopped(reason))
+        return len(remaining)

@@ -1,0 +1,119 @@
+"""The AOT bucket-signature manifest: exports are indexed per pipeline
+digest, a booting fleet pre-warms every recorded signature, and corrupt
+entries degrade to 'signature unknown'."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import keystone_tpu.compile as compile_mod
+from keystone_tpu.compile.cache import ExecutableCache
+from keystone_tpu.compile.manifest import exported_signatures, record_export
+
+
+@pytest.fixture
+def cache(tmp_path):
+    yield ExecutableCache(str(tmp_path / "aot"))
+
+
+def test_record_and_list_round_trip(cache):
+    record_export(cache, "digA", (8, 4), "float32")
+    record_export(cache, "digA", (32, 4), "float32")
+    record_export(cache, "digB", (8, 2), "float32")
+    assert exported_signatures(cache, "digA") == [
+        ((8, 4), "float32"),
+        ((32, 4), "float32"),
+    ]
+    assert exported_signatures(cache, "digB") == [((8, 2), "float32")]
+    assert exported_signatures(cache, "missing") == []
+
+
+def test_record_is_idempotent(cache):
+    for _ in range(3):
+        record_export(cache, "digA", (8, 4), "float32")
+    d = os.path.join(cache.root, "manifest", "digA")
+    assert len(os.listdir(d)) == 1
+    assert exported_signatures(cache, "digA") == [((8, 4), "float32")]
+
+
+def test_corrupt_entry_skipped_not_fatal(cache):
+    record_export(cache, "digA", (8, 4), "float32")
+    d = os.path.join(cache.root, "manifest", "digA")
+    with open(os.path.join(d, "garbage.json"), "w") as f:
+        f.write("{not json")
+    # a structurally valid but foreign record is also skipped
+    with open(os.path.join(d, "foreign.json"), "w") as f:
+        json.dump({"unexpected": True}, f)
+    assert exported_signatures(cache, "digA") == [((8, 4), "float32")]
+
+
+def _toy_fitted():
+    from keystone_tpu.workflow.transformer import FunctionNode
+
+    def double(X):
+        return X * 2.0
+
+    return FunctionNode(batch_fn=double, label="double").to_pipeline().fit()
+
+
+def test_engine_export_records_manifest_and_fleet_prewarms_it(tmp_path):
+    """The PR 6 follow-on, closed: process A's engine exports its buckets
+    (manifest written); process-B's-stand-in fleet configured with FEWER
+    buckets still pre-warms every manifest signature at start() — zero
+    cold first-requests for shapes the pipeline has served before."""
+    from keystone_tpu.serving import ServingEngine, ServingFleet
+
+    cachedir = str(tmp_path / "aot")
+    try:
+        compile_mod.configure(cachedir)
+        fitted = _toy_fitted()
+        engine = ServingEngine(fitted, buckets=(2, 4), datum_shape=(3,))
+        engine.start()
+        engine.shutdown()
+        assert engine.metrics.count("compiles") == 2
+
+        digest = fitted.fingerprint()
+        cache = compile_mod.get_cache()
+        sigs = exported_signatures(cache, digest)
+        assert ((2, 3), "float32") in sigs and ((4, 3), "float32") in sigs
+
+        # the fleet asks for ONE bucket but pre-warms BOTH manifest
+        # signatures — all loaded from the cache, zero traces
+        fleet = ServingFleet(
+            _toy_fitted(), replicas=2, buckets=(2,), datum_shape=(3,)
+        )
+        warmed = fleet.warm_up()
+        assert warmed == 2  # bucket (2,) + the manifest's extra (4, 3)
+        assert fleet.metrics.count("compiles") == 0
+        assert fleet.metrics.count("aot_loads") == 2
+        fleet.start(warmup=False)
+        out = fleet.predict(np.ones(3, np.float32), timeout=30.0)
+        np.testing.assert_allclose(np.asarray(out), 2 * np.ones(3), rtol=1e-6)
+        fleet.shutdown()
+        assert fleet.metrics.count("compiles") == 0
+    finally:
+        compile_mod.reset()
+
+
+def test_manifest_filters_foreign_contracts(tmp_path):
+    """Signatures whose per-item shape or dtype does not match the
+    fleet's contract are not warmed (they would trace programs this
+    fleet can never serve)."""
+    from keystone_tpu.serving import ServingFleet
+
+    cachedir = str(tmp_path / "aot")
+    try:
+        compile_mod.configure(cachedir)
+        fitted = _toy_fitted()
+        fleet = ServingFleet(
+            fitted, replicas=1, buckets=(2,), datum_shape=(3,)
+        )
+        cache = compile_mod.get_cache()
+        digest = fitted.fingerprint()
+        record_export(cache, digest, (8, 7), "float32")   # wrong item shape
+        record_export(cache, digest, (8, 3), "float64")   # wrong dtype
+        assert fleet._manifest_signatures() == []
+    finally:
+        compile_mod.reset()

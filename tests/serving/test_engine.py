@@ -146,6 +146,25 @@ def test_submit_after_drain_raises_engine_closed():
         engine.submit(np.ones(2))
 
 
+def test_submit_under_shutdown_is_typed_engine_stopped():
+    """The admission-vs-shutdown check-and-enqueue is atomic and the
+    refusal is the TYPED EngineStopped (refining EngineClosed), so fleet
+    callers can branch on an orderly stop without string-matching."""
+    from keystone_tpu.serving import EngineStopped
+
+    engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    engine.start()
+    engine.shutdown(drain=True)
+    with pytest.raises(EngineStopped):
+        engine.submit(np.ones(2))
+    # a request swept at shutdown resolves to the same typed error
+    engine2 = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
+    fut = engine2.submit(np.ones(2))
+    engine2.shutdown()
+    with pytest.raises(EngineStopped):
+        fut.result(timeout=5)
+
+
 def test_shutdown_without_start_rejects_queued_requests():
     engine = ServingEngine(_toy_fitted(), buckets=(4,), datum_shape=(2,))
     fut = engine.submit(np.ones(2))

@@ -1,0 +1,331 @@
+"""ServingFleet: the replicated continuous-batching serving layer —
+hammer traffic with a mid-load fleet-wide swap (zero dropped/failed),
+deterministic deadline shedding at admission, work-stealing rebalance,
+and canary-mismatch auto-rollback."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from keystone_tpu.serving import (
+    CanaryMismatch,
+    EngineClosed,
+    EngineStopped,
+    QueueFull,
+    ServingFleet,
+    Shed,
+)
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def _linear_fitted(scale, label=None):
+    return FunctionNode(
+        batch_fn=lambda X, s=scale: X * s, label=label or f"scale{scale}"
+    ).to_pipeline().fit()
+
+
+def _toy_fitted():
+    return (
+        FunctionNode(batch_fn=lambda X: X * 2.0, label="double")
+        >> FunctionNode(batch_fn=lambda X: X.sum(axis=1), label="rowsum")
+    ).fit()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle / routing
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_serves_correct_results_across_replicas():
+    fleet = ServingFleet(
+        _toy_fitted(), replicas=2, buckets=(4, 8), datum_shape=(3,),
+        max_wait_ms=1.0,
+    )
+    with fleet:
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            res = list(pool.map(
+                lambda i: float(np.asarray(
+                    fleet.predict(np.full(3, float(i)), timeout=30.0)
+                ).ravel()[0]),
+                range(48),
+            ))
+    for i, r in enumerate(res):
+        assert abs(r - 6.0 * i) < 1e-4
+    snap = fleet.metrics.snapshot()
+    c = snap["counters"]
+    assert c["completed"] == c["submitted"] == 48
+    assert c.get("batch_errors", 0) == 0
+    # both replica workers actually executed batches, and the snapshot
+    # attributes occupancy per replica
+    assert set(snap["replicas"]) == {"0", "1"}
+    assert all(row["batches"] >= 1 for row in snap["replicas"].values())
+    # queue-age quantiles observed for every dispatched request
+    assert snap["queue_age"]["count"] == 48
+
+
+def test_fleet_default_replica_count_is_one_per_device():
+    import jax
+
+    fleet = ServingFleet(_toy_fitted(), datum_shape=(3,))
+    assert fleet.n_replicas == len(jax.devices())  # 8 on the test mesh
+    # replica i is pinned to data-axis device i
+    assert [r.device for r in fleet.replicas] == list(jax.devices())
+
+
+def test_fleet_shares_one_executable_trace_across_replicas():
+    """The fleet pays each bucket trace ONCE no matter the replica count."""
+    fleet = ServingFleet(
+        _toy_fitted(), replicas=4, buckets=(4, 8), datum_shape=(3,)
+    )
+    fleet.start()
+    assert fleet.metrics.count("compiles") == 2  # one per bucket, not x4
+    assert len(fleet.compiled_signatures) == 2
+    fleet.shutdown()
+
+
+def test_submit_after_shutdown_raises_typed_engine_stopped():
+    fleet = ServingFleet(_toy_fitted(), replicas=2, datum_shape=(3,))
+    fleet.start()
+    fleet.shutdown()
+    with pytest.raises(EngineStopped):
+        fleet.submit(np.ones(3))
+    # EngineStopped stays catchable as the EngineClosed it refines
+    with pytest.raises(EngineClosed):
+        fleet.submit(np.ones(3))
+
+
+def test_shutdown_without_start_answers_queued_and_is_idempotent():
+    fleet = ServingFleet(_toy_fitted(), replicas=2, datum_shape=(3,))
+    fut = fleet.submit(np.ones(3))
+    fleet.shutdown()
+    fleet.shutdown()  # idempotent
+    with pytest.raises(EngineStopped):
+        fut.result(timeout=5)
+
+
+def test_queue_full_is_typed_and_counted():
+    fleet = ServingFleet(
+        _toy_fitted(), replicas=2, datum_shape=(3,), max_queue=4
+    )
+    for _ in range(4):
+        fleet.submit(np.ones(3))
+    with pytest.raises(QueueFull):
+        fleet.submit(np.ones(3))
+    assert fleet.metrics.count("rejected") == 1
+    fleet.start()  # queued four still drain normally
+    fleet.shutdown(drain=True)
+    assert fleet.metrics.count("completed") == 4
+
+
+# ---------------------------------------------------------------------------
+# deadline shedding at admission
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_shed_is_deterministic_given_service_evidence():
+    """With a seeded service estimate, a deadline below the floor is shed
+    every single time; a deadline above it is admitted every time."""
+    fleet = ServingFleet(
+        _toy_fitted(), replicas=1, buckets=(4,), datum_shape=(3,)
+    )
+    fleet.scheduler.observe_service(0.5)  # every batch "takes" 500ms
+    fleet.start()
+    for _ in range(10):
+        with pytest.raises(Shed):
+            fleet.submit(np.ones(3), timeout=0.05)  # < the service floor
+    assert fleet.metrics.count("shed") == 10
+    # a meetable deadline is never shed on an empty fleet
+    for _ in range(5):
+        assert abs(fleet.predict(np.ones(3), timeout=30.0) - 6.0) < 1e-4
+    assert fleet.metrics.count("shed") == 10
+    fleet.shutdown()
+    snap = fleet.metrics.snapshot()
+    assert snap["counters"]["completed"] == 5
+
+
+def test_cold_scheduler_never_sheds():
+    """No service evidence => no shedding: the scheduler cannot justify
+    refusing work it knows nothing about."""
+    fleet = ServingFleet(
+        _toy_fitted(), replicas=1, buckets=(4,), datum_shape=(3,)
+    )
+    assert fleet.scheduler.service_estimate is None
+    assert fleet.scheduler.estimated_wait() == 0.0
+    fleet.start()
+    assert abs(fleet.predict(np.ones(3), timeout=0.5) - 6.0) < 1e-4
+    fleet.shutdown()
+    assert fleet.metrics.count("shed") == 0
+
+
+# ---------------------------------------------------------------------------
+# the hammer: concurrent submitters + mid-load fleet-wide swap
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_hammer_with_mid_load_swap_zero_dropped_or_failed():
+    """Concurrent submitters across a fleet-wide swap: every request
+    resolves to one of the two models' outputs, nothing dropped, nothing
+    failed, and everything after the swap returns runs the new model."""
+    fleet = ServingFleet(
+        _linear_fitted(2.0), replicas=2, buckets=(4,), datum_shape=(2,),
+        max_wait_ms=1.0,
+    )
+    with fleet:
+        stop = [False]
+        results = []
+
+        def hammer():
+            while not stop[0]:
+                results.append(float(np.asarray(
+                    fleet.predict(np.ones(2), timeout=30.0)
+                ).ravel()[0]))
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(hammer) for _ in range(4)]
+            time.sleep(0.2)
+            report = fleet.swap(_linear_fitted(3.0))
+            assert report["replicas_flipped"] == 2
+            assert report["buckets_warmed"] == 1
+            post = float(np.asarray(
+                fleet.predict(np.ones(2), timeout=30.0)
+            ).ravel()[0])
+            time.sleep(0.2)
+            stop[0] = True
+            for f in futs:
+                f.result(timeout=30)
+        assert post == 3.0
+        snap = fleet.metrics.snapshot()
+
+    c = snap["counters"]
+    assert c["completed"] == c["submitted"]
+    assert c.get("rejected", 0) == 0 and c.get("batch_errors", 0) == 0
+    assert c["swaps"] == 1
+    assert set(results) <= {2.0, 3.0}
+    assert 2.0 in results and 3.0 in results
+
+
+def test_swap_rejects_contract_mismatch_and_closed_fleet():
+    fleet = ServingFleet(_toy_fitted(), replicas=2, datum_shape=(2,))
+    wrong = _linear_fitted(1.0, label="id3")
+    wrong.datum_shape = (3,)
+    with pytest.raises(ValueError, match="does not match"):
+        fleet.swap(wrong)
+    fleet.start()
+    fleet.shutdown()
+    with pytest.raises(EngineStopped):
+        fleet.swap(_linear_fitted(3.0))
+
+
+# ---------------------------------------------------------------------------
+# canary: shadow-compare, promote or auto-rollback
+# ---------------------------------------------------------------------------
+
+
+def _with_traffic(fleet, fn):
+    """Run ``fn()`` while hammer threads keep the fleet busy."""
+    stop = [False]
+
+    def hammer():
+        while not stop[0]:
+            fleet.predict(np.ones(2), timeout=30.0)
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.1)
+        return fn()
+    finally:
+        stop[0] = True
+        for t in threads:
+            t.join()
+
+
+def test_canary_mismatch_auto_rolls_back():
+    fleet = ServingFleet(
+        _linear_fitted(2.0), replicas=2, buckets=(4,), datum_shape=(2,),
+        max_wait_ms=1.0,
+    )
+    with fleet:
+        def do_swap():
+            with pytest.raises(CanaryMismatch) as exc:
+                fleet.swap(
+                    _linear_fitted(5.0, label="bad"),
+                    canary_fraction=1.0, canary_batches=2,
+                    canary_timeout_s=20.0,
+                )
+            return exc.value
+
+        err = _with_traffic(fleet, do_swap)
+        # the report carries the mirrored-batch evidence
+        assert err.report["mismatches"] >= 1
+        assert err.report["batches_compared"] >= 1
+        assert err.report["mismatch_details"][0]["max_abs_diff"] > 1.0
+        # NOTHING was promoted: the fleet still serves the old model
+        assert float(np.asarray(
+            fleet.predict(np.ones(2), timeout=30.0)
+        ).ravel()[0]) == 2.0
+        assert fleet.metrics.count("swaps") == 0
+        assert fleet.metrics.count("canary_fail") == 1
+        assert fleet.metrics.count("canary_pass") == 0
+
+
+def test_canary_pass_promotes_with_verdict():
+    fleet = ServingFleet(
+        _linear_fitted(2.0), replicas=2, buckets=(4,), datum_shape=(2,),
+        max_wait_ms=1.0,
+    )
+    with fleet:
+        report = _with_traffic(
+            fleet,
+            lambda: fleet.swap(
+                _linear_fitted(2.0, label="equivalent"),
+                canary_fraction=1.0, canary_batches=2,
+                canary_timeout_s=20.0,
+            ),
+        )
+        assert report["canary"]["mismatches"] == 0
+        assert report["canary"]["batches_compared"] >= 2
+        assert fleet.metrics.count("canary_pass") == 1
+        assert fleet.metrics.count("swaps") == 1
+        # latency comparison rode along with the output comparison
+        assert report["canary"]["latency_ratio"] is not None
+
+
+def test_canary_latency_gate_rolls_back_a_slow_candidate():
+    """Identical outputs but a latency ratio above the gate still rolls
+    back — the 'compare outputs/latency' promise, both halves."""
+    fleet = ServingFleet(
+        _linear_fitted(2.0), replicas=2, buckets=(4,), datum_shape=(2,),
+        max_wait_ms=1.0,
+    )
+
+    def slow_double(X):
+        import jax
+
+        def _stall(x):
+            time.sleep(0.05)
+            return x
+
+        return jax.pure_callback(
+            _stall, jax.ShapeDtypeStruct(X.shape, X.dtype), X
+        ) * 2.0
+
+    slow = FunctionNode(batch_fn=slow_double, label="slow").to_pipeline().fit()
+    with fleet:
+        def do_swap():
+            with pytest.raises(CanaryMismatch, match="latency"):
+                fleet.swap(
+                    slow, canary_fraction=1.0, canary_batches=3,
+                    canary_timeout_s=20.0, max_latency_ratio=3.0,
+                )
+
+        _with_traffic(fleet, do_swap)
+        assert fleet.metrics.count("swaps") == 0
+        # old model still live
+        assert float(np.asarray(
+            fleet.predict(np.ones(2), timeout=30.0)
+        ).ravel()[0]) == 2.0

@@ -73,3 +73,48 @@ def test_maybe_log_is_rate_limited(caplog):
         assert m.maybe_log(60.0) is True
         assert m.maybe_log(60.0) is False
     assert len(caplog.records) == 1
+
+
+def test_queue_age_quantiles_same_schema_as_latency():
+    m = MetricsRegistry("t")
+    for v in np.linspace(0.001, 0.1, 100):
+        m.observe_queue_age(float(v))
+    q = m.queue_age_quantiles()
+    assert q["count"] == 100
+    assert q["p50"] <= q["p95"] <= q["p99"]
+    snap = m.snapshot()
+    assert snap["queue_age"]["p99"] == q["p99"]
+    # empty registry reports a bare count, exactly like latency
+    assert MetricsRegistry("e").queue_age_quantiles() == {"count": 0}
+
+
+def test_per_replica_occupancy_in_snapshot():
+    m = MetricsRegistry("t")
+    m.observe_batch(6, 8, replica=0)
+    m.observe_batch(2, 8, replica=0)
+    m.observe_batch(8, 8, replica=1)
+    snap = m.snapshot()
+    # fleet-wide occupancy still aggregates everything
+    assert snap["batch_occupancy"]["items"] == 16
+    per = snap["replicas"]
+    assert per["0"]["batches"] == 2 and per["1"]["batches"] == 1
+    assert abs(per["0"]["occupancy"] - 0.5) < 1e-9
+    assert per["1"]["occupancy"] == 1.0
+    # replica-less observations (the single engine) don't create rows
+    m2 = MetricsRegistry("t2")
+    m2.observe_batch(4, 8)
+    assert m2.snapshot()["replicas"] == {}
+
+
+def test_periodic_log_includes_shed_and_canary_verdicts(caplog):
+    import logging
+
+    m = MetricsRegistry("shed-log-test")
+    m.inc("shed", 7)
+    m.inc("canary_pass")
+    m.inc("canary_fail", 2)
+    with caplog.at_level(logging.INFO, logger="keystone_tpu.serving.metrics"):
+        assert m.maybe_log(60.0) is True
+    line = caplog.records[-1].getMessage()
+    assert "shed=7" in line
+    assert "canary=1pass/2fail" in line

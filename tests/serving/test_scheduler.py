@@ -1,0 +1,224 @@
+"""FleetScheduler unit tests: admission math, continuous batch forming,
+work-stealing rebalance, and drain/stop bookkeeping — driven directly
+(no replicas, no jax) so every behavior is deterministic."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from keystone_tpu.serving.batching import BucketPolicy
+from keystone_tpu.serving.errors import EngineStopped, QueueFull, Shed
+from keystone_tpu.serving.metrics import MetricsRegistry
+from keystone_tpu.serving.replica import STOP, _Request
+from keystone_tpu.serving.scheduler import FleetScheduler
+
+
+def _req(deadline=None):
+    now = time.monotonic()
+    return _Request(
+        datum=None,
+        deadline=(now + deadline) if deadline is not None else None,
+        enqueued=now,
+    )
+
+
+def _sched(n=2, buckets=(4, 8), max_queue=64, max_wait_ms=1.0, steal=True):
+    return FleetScheduler(
+        n,
+        BucketPolicy(buckets, datum_shape=(2,)),
+        MetricsRegistry("sched-test"),
+        max_queue=max_queue,
+        max_wait_ms=max_wait_ms,
+        steal=steal,
+    )
+
+
+def _replica(index):
+    return SimpleNamespace(index=index, last_exec_seconds=None)
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+
+def test_estimated_wait_scales_with_depth_and_evidence():
+    s = _sched(n=2, buckets=(4, 8))
+    assert s.estimated_wait() == 0.0  # cold: no evidence, no estimate
+    s.observe_service(0.1)
+    assert s.estimated_wait() == pytest.approx(0.1)
+    # 16 queued = one full fleet round (2 replicas x 8-bucket) ahead
+    for _ in range(16):
+        s.admit(_req())
+    assert s.estimated_wait() == pytest.approx(0.2)
+
+
+def test_ewma_follows_observations():
+    s = _sched()
+    s.observe_service(0.1)
+    for _ in range(50):
+        s.observe_service(0.5)
+    assert 0.45 < s.service_estimate <= 0.5
+
+
+def test_admit_sheds_unmeetable_deadline_and_counts():
+    s = _sched(n=1, buckets=(4,))
+    s.observe_service(0.5)
+    for _ in range(3):
+        with pytest.raises(Shed):
+            s.admit(_req(deadline=0.05))
+    assert s._metrics.count("shed") == 3
+    # the same deadline with slack admits
+    s.admit(_req(deadline=5.0))
+    assert s.depth == 1
+
+
+def test_admit_respects_queue_bound_and_close():
+    s = _sched(n=1, buckets=(4,), max_queue=2)
+    s.admit(_req())
+    s.admit(_req())
+    with pytest.raises(QueueFull):
+        s.admit(_req())
+    s.close()
+    with pytest.raises(EngineStopped):
+        s.admit(_req())
+
+
+def test_admission_balances_to_shallowest_queue():
+    s = _sched(n=2, steal=False)
+    for _ in range(6):
+        s.admit(_req())
+    assert s.queue_depths() == [3, 3]
+
+
+# ---------------------------------------------------------------------------
+# continuous batch forming
+# ---------------------------------------------------------------------------
+
+
+def test_next_batch_dispatches_exactly_full_bucket_without_waiting():
+    s = _sched(n=1, buckets=(4, 8), max_wait_ms=10_000.0)
+    for _ in range(4):
+        s.admit(_req())
+    t0 = time.monotonic()
+    batch = s.next_batch(_replica(0))
+    # bucket 4 exactly full => occupancy 1.0, no reason to wait out the
+    # (enormous) max-wait window
+    assert len(batch) == 4
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_request_arriving_during_forming_joins_the_batch():
+    """Continuous batching: the forming batch admits arrivals until its
+    bucket fills — no gather-then-dispatch barrier."""
+    s = _sched(n=1, buckets=(2,), max_wait_ms=5_000.0)
+    s.admit(_req())
+
+    def late_arrival():
+        time.sleep(0.1)
+        s.admit(_req())
+
+    t = threading.Thread(target=late_arrival)
+    t.start()
+    batch = s.next_batch(_replica(0))
+    t.join()
+    assert len(batch) == 2  # the late request joined, filling the bucket
+
+
+def test_tight_deadline_forces_dispatch_instead_of_waiting():
+    """A known service time + a tight deadline => the scheduler dispatches
+    a partial bucket rather than waiting the deadline away."""
+    s = _sched(n=1, buckets=(8,), max_wait_ms=10_000.0)
+    s.observe_service(0.05)
+    s.admit(_req(deadline=0.2))
+    t0 = time.monotonic()
+    batch = s.next_batch(_replica(0))
+    waited = time.monotonic() - t0
+    assert len(batch) == 1
+    # dispatched within the deadline's slack, nowhere near max_wait
+    assert waited < 0.25
+
+
+def test_batch_done_learns_service_time_from_replica():
+    s = _sched(n=1, buckets=(4,))
+    s.admit(_req())
+    rep = _replica(0)
+    batch = s.next_batch(rep)
+    rep.last_exec_seconds = 0.123
+    s.batch_done(batch, rep)
+    assert s.service_estimate == pytest.approx(0.123)
+
+
+# ---------------------------------------------------------------------------
+# work stealing
+# ---------------------------------------------------------------------------
+
+
+def _preload(s, index, n):
+    """Force-place n requests on one queue (bypassing balanced admission)
+    to model a replica whose bucket mix stalled it mid-drain."""
+    with s._lock:
+        for _ in range(n):
+            s._queues[index].append(_req())
+            s._depth += 1
+
+
+def test_idle_replica_steals_newest_half_from_deepest_peer():
+    s = _sched(n=2, buckets=(4, 8), max_wait_ms=1.0)
+    _preload(s, 0, 12)
+    batch = s.next_batch(_replica(1))  # replica 1's own queue is empty
+    assert batch is not STOP and len(batch) >= 1
+    assert s._metrics.count("steals") == 6  # newest half of 12
+    # the victim kept its oldest half minus nothing (thief served from
+    # its own queue after the move)
+    depths = s.queue_depths()
+    assert depths[0] == 6
+
+
+def test_steal_disabled_pins_requests_to_their_queue():
+    s = _sched(n=2, buckets=(4,), max_wait_ms=1.0, steal=False)
+    _preload(s, 0, 8)
+
+    got = []
+
+    def try_take():
+        # replica 1 must NOT serve replica 0's queue; it waits until stop
+        got.append(s.next_batch(_replica(1)))
+
+    t = threading.Thread(target=try_take)
+    t.start()
+    time.sleep(0.3)
+    s.stop()
+    t.join(timeout=5)
+    assert got == [STOP]
+    assert s.queue_depths()[0] == 8
+
+
+# ---------------------------------------------------------------------------
+# drain / stop
+# ---------------------------------------------------------------------------
+
+
+def test_wait_idle_blocks_until_queues_and_inflight_clear():
+    s = _sched(n=1, buckets=(4,))
+    s.admit(_req())
+    assert s.wait_idle(timeout=0.2) is False  # queued work: not idle
+    rep = _replica(0)
+    batch = s.next_batch(rep)
+    assert s.wait_idle(timeout=0.2) is False  # in-flight: still not idle
+    s.batch_done(batch, rep)
+    assert s.wait_idle(timeout=5.0) is True
+
+
+def test_fail_remaining_answers_everything_with_engine_stopped():
+    s = _sched(n=2, buckets=(4,))
+    reqs = [_req() for _ in range(5)]
+    for r in reqs:
+        s.admit(r)
+    assert s.fail_remaining() == 5
+    for r in reqs:
+        with pytest.raises(EngineStopped):
+            r.future.result(timeout=1)
+    assert s.depth == 0
