@@ -2948,6 +2948,212 @@ def bench_mqo_sweep() -> dict:
     }
 
 
+def bench_fault_tolerance() -> dict:
+    """Fault-tolerant execution (keystone_tpu/faults/): the three chaos
+    gates, each driven by a deterministic seeded fault plan.
+
+    Per the 2-vCPU container constraint, the scan and serving pipelines
+    here are stall-bearing (host sleeps standing in for the I/O work a
+    real chunk load / feature fetch does), so recovery overlaps real
+    stalls rather than fantasy spare cores.
+
+    Gates:
+      * scan_retry_parity_ok — a streaming fit under an injected
+        transient chunk/staging fault schedule (retries on) completes
+        and matches the clean fit to 1e-6, with >= 1 fault injected and
+        retried;
+      * fleet_kill_zero_failures_ok / fleet_kill_p99_ok — a 2-replica
+        fleet under steady load with a mid-run replica thread kill
+        answers EVERY accepted request (supervised restart + requeue,
+        restarts >= 1) and accepted p99 stays within budget;
+      * resume_bitequal_ok / resume_work_ok — a checkpointed
+        out-of-core fit killed mid-pass by a fatal fault, then re-run,
+        folds solver state BIT-IDENTICAL to an uninterrupted fit while
+        re-producing only the unfolded chunks."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from keystone_tpu import faults
+    from keystone_tpu.data.chunked import ChunkedDataset
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+
+    rng = np.random.RandomState(17)
+
+    # -- gate 1: scan-retry parity under a seeded fault schedule ---------
+    n, d, k, cs = 256, 32, 4, 32
+    X = rng.randn(n, d).astype(np.float32)
+    Y = rng.randn(n, k).astype(np.float32)
+    chunks = [X[i : i + cs] for i in range(0, n, cs)]
+    stall_s = 0.003  # per-chunk host stall: the chunk-load I/O stand-in
+
+    def chunk_fn(i):
+        time.sleep(stall_s)
+        return chunks[i]
+
+    ds = ChunkedDataset.from_chunk_fn(
+        chunk_fn, len(chunks), n, label="fault_bench"
+    )
+    labels = Dataset(Y, batched=True)
+
+    os.environ["KEYSTONE_SCAN_RETRIES"] = "8"
+    os.environ["KEYSTONE_SCAN_RETRY_BACKOFF"] = "0.005"
+    try:
+        t0 = time.perf_counter()
+        clean = LinearMapEstimator(lam=0.5).fit(ds, labels)
+        clean_s = time.perf_counter() - t0
+        faults.install(
+            faults.parse_plan(
+                "scan.chunk=transient@1,4,6;scan.stage=transient@3"
+            )
+        )
+        t0 = time.perf_counter()
+        faulted = LinearMapEstimator(lam=0.5).fit(ds, labels)
+        faulted_s = time.perf_counter() - t0
+        injected = dict(faults.active_plan().injected)
+        faults.clear()
+        scan_parity = float(
+            np.max(np.abs(np.asarray(clean.W) - np.asarray(faulted.W)))
+        )
+        scan_gate = scan_parity <= 1e-6 and sum(injected.values()) >= 2
+    finally:
+        os.environ.pop("KEYSTONE_SCAN_RETRIES", None)
+        os.environ.pop("KEYSTONE_SCAN_RETRY_BACKOFF", None)
+
+    # -- gate 2: fleet goodput under a mid-load replica kill -------------
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.serving import ServingFleet
+    from keystone_tpu.workflow.transformer import FunctionNode
+
+    serve_d = 128
+    serve_stall = 0.004
+    p99_budget_s = 0.75
+    Wm = jnp.asarray(rng.randn(serve_d, 8).astype(np.float32))
+
+    def _stall(x):
+        time.sleep(serve_stall)
+        return x
+
+    def body(Xb):
+        Xb = jax.pure_callback(
+            _stall, jax.ShapeDtypeStruct(Xb.shape, Xb.dtype), Xb
+        )
+        return jnp.tanh(Xb @ Wm)
+
+    fitted = FunctionNode(
+        batch_fn=body, label="fault_stall_matmul"
+    ).to_pipeline().fit()
+    data = rng.randn(64, serve_d).astype(np.float32)
+
+    # the 9th batch fleet-wide kills its replica's thread mid-load
+    faults.install(faults.parse_plan("replica.batch=kill@8"))
+    fleet = ServingFleet(
+        fitted, replicas=2, buckets=(8,), datum_shape=(serve_d,),
+        max_wait_ms=2.0, max_queue=1024,
+    )
+    n_requests = 256
+    lat = []
+
+    def one(i):
+        t0 = time.perf_counter()
+        fleet.predict(data[i % len(data)], timeout=30.0)
+        lat.append(time.perf_counter() - t0)
+
+    with fleet:
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=24) as pool:
+            list(pool.map(one, range(n_requests)))
+        kill_wall = time.perf_counter() - t0
+        snap = fleet.metrics.snapshot()
+    faults.clear()
+    c = snap["counters"]
+    accepted_p99 = sorted(lat)[int(len(lat) * 0.99) - 1]
+    kill_zero_failures = (
+        len(lat) == n_requests
+        and c["completed"] == c["submitted"] == n_requests
+        and c.get("restarts", 0) >= 1
+    )
+    kill_p99_ok = accepted_p99 <= p99_budget_s
+
+    # -- gate 3: checkpoint resume bit-equality --------------------------
+    import tempfile
+
+    produced = []
+
+    def counted_chunk_fn(i):
+        produced.append(i)
+        time.sleep(stall_s)
+        return chunks[i]
+
+    ds_ck = ChunkedDataset.from_chunk_fn(
+        counted_chunk_fn, len(chunks), n, label="fault_ckpt"
+    )
+    ref = LinearMapEstimator(lam=0.5, snapshot=True).fit(ds_ck, labels)
+    with tempfile.TemporaryDirectory() as tmp:
+        faults.install(faults.parse_plan("scan.chunk=fatal@5"))
+        produced.clear()
+        killed = False
+        try:
+            LinearMapEstimator(
+                lam=0.5, snapshot=True, checkpoint=tmp
+            ).fit(ds_ck, labels)
+        except faults.FatalFaultInjected:
+            killed = True
+        faults.clear()
+        killed_chunks = sorted(set(produced))
+        produced.clear()
+        resumed = LinearMapEstimator(
+            lam=0.5, snapshot=True, checkpoint=tmp
+        ).fit(ds_ck, labels)
+        resumed_chunks = sorted(set(produced))
+    s_ref, s_res = ref.solver_state, resumed.solver_state
+    resume_bitequal = (
+        killed
+        and np.array_equal(s_ref.gram, s_res.gram)
+        and np.array_equal(s_ref.cross, s_res.cross)
+        and np.array_equal(s_ref.sum_x, s_res.sum_x)
+        and s_ref.n == s_res.n
+    )
+    # resume produced ONLY chunks the killed run never folded
+    resume_work_ok = (
+        len(resumed_chunks) < len(chunks)
+        and not set(resumed_chunks) & set(killed_chunks)
+    )
+
+    return {
+        "gates": {
+            "scan_retry_parity_ok": bool(scan_gate),
+            "fleet_kill_zero_failures_ok": bool(kill_zero_failures),
+            "fleet_kill_p99_ok": bool(kill_p99_ok),
+            "resume_bitequal_ok": bool(resume_bitequal),
+            "resume_work_ok": bool(resume_work_ok),
+        },
+        "scan_retry": {
+            "injected": injected,
+            "parity_max_abs": scan_parity,
+            "clean_fit_seconds": round(clean_s, 4),
+            "faulted_fit_seconds": round(faulted_s, 4),
+        },
+        "fleet_kill": {
+            "requests": n_requests,
+            "completed": c.get("completed", 0),
+            "restarts": c.get("restarts", 0),
+            "requeues": c.get("requeues", 0),
+            "accepted_p99_s": round(accepted_p99, 4),
+            "p99_budget_s": p99_budget_s,
+            "wall_seconds": round(kill_wall, 4),
+        },
+        "checkpoint_resume": {
+            "chunks_total": len(chunks),
+            "killed_run_produced": killed_chunks,
+            "resumed_run_produced": resumed_chunks,
+        },
+    }
+
+
 def _section(name, fn):
     """Run one bench section with stderr progress (stdout stays pure JSON)."""
     import sys
@@ -2984,6 +3190,7 @@ def main() -> int:
     mqo_sweep = _section("mqo_sweep", bench_mqo_sweep)
     weak_scaling = _section("weak_scaling", bench_weak_scaling)
     sharded_scan = _section("sharded_scan", bench_sharded_scan)
+    fault_tolerance = _section("fault_tolerance", bench_fault_tolerance)
     from keystone_tpu.obs import tracer as trace_mod
 
     tracer = trace_mod.current()
@@ -3029,6 +3236,7 @@ def main() -> int:
                     "mqo_sweep": mqo_sweep,
                     "weak_scaling_virtual_mesh": weak_scaling,
                     "sharded_scan": sharded_scan,
+                    "fault_tolerance": fault_tolerance,
                     "trace": trace_extra,
                 },
             }

@@ -11,6 +11,9 @@
 # cache dir and pre-warm from the bucket-signature manifest), every
 # replica served batches, and the trace carries per-replica
 # serve.replica spans plus the scheduler's serve.dispatch events.
+# Finally a fault-tolerance stage: under an injected mid-demo replica
+# thread kill (KEYSTONE_FAULTS), the supervised fleet must answer every
+# request (zero failures) and record restarts >= 1.
 # Extra flags pass through to the demo, e.g.:
 #   bin/serve-smoke.sh --requests 128 --buckets 8,32,64
 set -euo pipefail
@@ -49,5 +52,33 @@ for e in dispatches:
 print(
     f"FLEET TRACE OK: {len(replica_spans)} serve.replica span(s) across "
     f"replicas {sorted(swaps_seen)}, {len(dispatches)} dispatch event(s)"
+)
+PY
+echo "== boot 4 (replica kill mid-demo: supervised restart, zero failed requests) =="
+env JAX_PLATFORMS=cpu KEYSTONE_FAULTS="replica.batch=kill@5" python - <<'PY'
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from keystone_tpu.serving import ServingFleet
+from keystone_tpu.serving.demo import build_demo_fitted
+
+fitted, test = build_demo_fitted(n_train=512)
+fleet = ServingFleet(fitted, replicas=2, buckets=(8,), max_wait_ms=2.0)
+n = 96
+with fleet:
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        outs = list(pool.map(
+            lambda i: fleet.predict(test[i % len(test)], timeout=30.0),
+            range(n),
+        ))
+c = fleet.metrics.snapshot()["counters"]
+assert len(outs) == n, f"answered {len(outs)}/{n}"
+assert c.get("completed") == c.get("submitted") == n, c
+assert c.get("restarts", 0) >= 1, f"expected a supervised restart: {c}"
+assert c.get("batch_errors", 0) == 0, f"failed batches under kill: {c}"
+print(
+    f"KILL STAGE OK: {n}/{n} answered, restarts={c['restarts']}, "
+    f"requeues={c.get('requeues', 0)}, quarantined={c.get('quarantined', 0)}"
 )
 PY

@@ -136,6 +136,22 @@ class ExecutableCache:
         """Load + validate one entry. Returns None on miss, corruption,
         or environment mismatch — never raises for on-disk problems. A
         hit bumps the entry's mtime (the LRU recency signal)."""
+        from ..faults import AOT_READ, fault_point, is_transient
+
+        try:
+            # the chaos seam for cache reads: a transient fault here is
+            # exactly a flaky filesystem, and the recovery is the one the
+            # cache already has — degrade to a miss (the caller traces
+            # live and re-exports), never fail the serving boot
+            fault_point(AOT_READ, key=key)
+        except Exception as e:
+            if is_transient(e):
+                logger.warning(
+                    "aot cache: transient read fault for %s — degrading "
+                    "to a miss", key,
+                )
+                return None
+            raise
         path = self.entry_path(key)
         try:
             with open(path, "rb") as f:

@@ -56,6 +56,50 @@ def prefetch_to_device(chunks, depth: int = 2):
     return serial_staged(chunks, depth)
 
 
+class _InjectedChunks:
+    """The ``scan.chunk`` fault-injection + retry seam: fires the fault
+    point before each pull, INSIDE this iterator, so a transient fault
+    retries (bounded backoff) without killing the underlying generator;
+    exhaustion propagates the original error. The ``retry_budget`` is
+    exposed so a wrapping :class:`~keystone_tpu.data.pipeline_scan.
+    ScanPipeline` ADOPTS it — one budget (and one span-visible retry
+    count) per scan across the chunk and staging stages. Only installed
+    when a fault plan is active."""
+
+    def __init__(self, it: Iterator[Any], label: str):
+        from ..faults import RetryBudget
+
+        self._it = it
+        self._label = label
+        self.retry_budget = RetryBudget(label=f"scan[{label}]")
+
+    def __iter__(self) -> "_InjectedChunks":
+        return self
+
+    def __next__(self) -> Any:
+        from ..faults import SCAN_CHUNK, retry_call
+
+        retry_call(
+            lambda: None, self.retry_budget, SCAN_CHUNK, label=self._label
+        )
+        return next(self._it)
+
+    def close(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
+
+def _maybe_inject(it: Iterator[Any], label: str) -> Iterator[Any]:
+    """Wrap ``it`` with the fault seam iff a plan is active (one dict
+    lookup on the no-plan path — zero overhead wrapping)."""
+    from ..faults import active_plan
+
+    if active_plan() is None:
+        return it
+    return _InjectedChunks(it, label)
+
+
 def rechunk_batched(dataset: "Dataset", sizes: Sequence[int]) -> "ChunkedDataset":
     """Chunked view of a materialized batched dataset at given boundaries —
     used to align an in-memory gather branch with a chunked one."""
@@ -148,6 +192,12 @@ class ChunkedDataset(Dataset):
         super().__init__(chunk_factory, batched=True)
         self._num_rows = int(num_rows)
         self._label = label or "chunked"
+        #: optional ``fn(start) -> iterator`` yielding chunks from index
+        #: ``start`` WITHOUT producing the skipped prefix — set by the
+        #: indexable constructors (from_array / from_chunk_fn) and
+        #: propagated through map/map_batch, so a checkpoint-resumed fit
+        #: re-enters the stream at its cursor instead of rescanning
+        self._skip_factory: Optional[Callable[[int], Iterator[Any]]] = None
 
     # ---- constructors ---------------------------------------------------
 
@@ -158,11 +208,15 @@ class ChunkedDataset(Dataset):
         if chunk_rows <= 0:
             raise ValueError("chunk_rows must be positive")
 
-        def factory():
-            for i in range(0, n, chunk_rows):
+        def from_chunk(start: int):
+            for i in range(start * chunk_rows, n, chunk_rows):
                 yield arr[i : i + chunk_rows]
 
-        return ChunkedDataset(factory, n, label=f"array[{n}]")
+        ds = ChunkedDataset(
+            lambda: from_chunk(0), n, label=f"array[{n}]"
+        )
+        ds._skip_factory = from_chunk
+        return ds
 
     @staticmethod
     def from_chunk_fn(
@@ -174,13 +228,28 @@ class ChunkedDataset(Dataset):
     ) -> "ChunkedDataset":
         """Chunks generated by index — the deterministic-regeneration source
         (synthetic benches, seeded loaders): ``chunk_fn(i)`` must return the
-        same payload for the same ``i`` on every scan."""
+        same payload for the same ``i`` on every scan.
 
-        def factory():
-            for i in range(num_chunks):
-                yield chunk_fn(i)
+        Because production is re-callable by index, this is the source
+        class where transient chunk-load failures (a typed
+        :class:`~keystone_tpu.faults.TransientError` from ``chunk_fn``)
+        genuinely RETRY under the scan's ``KEYSTONE_SCAN_RETRIES``
+        budget, instead of failing the scan on the first flake."""
+        from ..faults import SCAN_CHUNK, RetryBudget, retry_call
 
-        return ChunkedDataset(factory, num_rows, label=label)
+        def from_chunk(start: int):
+            budget = RetryBudget(label=f"chunk_fn[{label or 'chunked'}]")
+            for i in range(start, num_chunks):
+                yield retry_call(
+                    lambda i=i: chunk_fn(i), budget, SCAN_CHUNK,
+                    inject=False,
+                )
+
+        ds = ChunkedDataset(
+            lambda: from_chunk(0), num_rows, label=label
+        )
+        ds._skip_factory = from_chunk
+        return ds
 
     # ---- shape / access -------------------------------------------------
 
@@ -205,15 +274,32 @@ class ChunkedDataset(Dataset):
         ``cache`` and other whole-stream consumers need.
         ``KEYSTONE_SCAN_PIPELINE=0`` restores the serial in-thread scan."""
         return scan_pipeline(
-            self._payload(), label=self._label, lanes=lanes or 1
+            _maybe_inject(iter(self._payload()), self._label),
+            label=self._label, lanes=lanes or 1,
         )
 
-    def raw_chunks(self) -> Iterator[Any]:
+    def raw_chunks(self, skip: int = 0) -> Iterator[Any]:
         """One scan WITHOUT the pipelined runtime — for composition sites
         that feed another scan (derived factories, solvers that wrap the
         source in their own ``scan_pipeline``) where nesting pipelines
-        would stack threads for no additional overlap."""
-        return iter(self._payload())
+        would stack threads for no additional overlap.
+
+        ``skip`` starts the scan at chunk index ``skip`` — the
+        checkpoint-resume hook. Indexable sources (and chains built on
+        them through map/map_batch) skip WITHOUT producing the prefix;
+        opaque factories fall back to producing and discarding it (the
+        resume still skips the fold work, just not the production)."""
+        if skip <= 0:
+            return _maybe_inject(iter(self._payload()), self._label)
+        if self._skip_factory is not None:
+            return _maybe_inject(
+                iter(self._skip_factory(skip)), self._label
+            )
+        it = iter(self._payload())
+        for _ in range(skip):
+            if next(it, None) is None:
+                break
+        return _maybe_inject(it, self._label)
 
     def __iter__(self) -> Iterator[Any]:
         # stage=False: per-row consumers are host code — hand them chunks
@@ -309,14 +395,21 @@ class ChunkedDataset(Dataset):
         """Lazily apply ``fn`` to every chunk — the transformer-chain hook.
         The returned dataset recomputes ``fn`` per scan (lineage)."""
         parent = self._payload
+        parent_skip = self._skip_factory
 
         def factory():
             for chunk in parent():
                 yield fn(chunk)
 
-        return ChunkedDataset(
+        ds = ChunkedDataset(
             factory, self._num_rows, label=f"{self._label}|map_batch"
         )
+        if parent_skip is not None:
+            # skipping the parent also skips fn over the skipped prefix
+            ds._skip_factory = lambda start: (
+                fn(c) for c in parent_skip(start)
+            )
+        return ds
 
     def map(self, fn: Callable[[Any], Any]) -> "ChunkedDataset":
         """Per-item fallback, applied within each chunk and restacked.
@@ -330,6 +423,7 @@ class ChunkedDataset(Dataset):
         shared mutable state (a stateful rng, an accumulator closure)
         needs ``KEYSTONE_MAP_WORKERS=1``."""
         parent = self._payload
+        parent_skip = self._skip_factory
 
         import jax.numpy as jnp
 
@@ -338,13 +432,13 @@ class ChunkedDataset(Dataset):
                 fn(jax.tree_util.tree_map(lambda a: a[i], chunk))
             )
 
-        def factory():
+        def run(chunks):
             from concurrent.futures import ThreadPoolExecutor
 
             workers = map_workers()
             pool = ThreadPoolExecutor(workers) if workers > 1 else None
             try:
-                for chunk in parent():
+                for chunk in chunks:
                     rows = _payload_rows(chunk)
                     if pool is None or rows <= 1:
                         items = [one(chunk, i) for i in range(rows)]
@@ -357,9 +451,13 @@ class ChunkedDataset(Dataset):
                 if pool is not None:
                     pool.shutdown(wait=True)
 
-        return ChunkedDataset(
-            factory, self._num_rows, label=f"{self._label}|map"
+        ds = ChunkedDataset(
+            lambda: run(parent()), self._num_rows,
+            label=f"{self._label}|map",
         )
+        if parent_skip is not None:
+            ds._skip_factory = lambda start: run(parent_skip(start))
+        return ds
 
     def cache(self, budget_bytes: Optional[int] = None) -> Dataset:
         """Materialize iff the full set fits ``budget_bytes`` in HBM;

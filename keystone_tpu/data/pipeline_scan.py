@@ -42,6 +42,16 @@ the scan's span as ``collectives`` — the PAPERS.md #3 gate is that this is
 O(blocks), never O(chunks). ``lanes=1`` (any 1-device mesh, or
 ``KEYSTONE_SCAN_LANES=1``) is byte-identical to the single-device scan.
 
+Fault tolerance (``keystone_tpu/faults``): every scan owns one bounded
+transient-retry budget (``KEYSTONE_SCAN_RETRIES``, default 0 = fail
+fast). With a budget, transient failures — injected chaos faults at the
+``scan.chunk``/``scan.stage`` fault points, flaky H2D staging, a
+re-callable ``from_chunk_fn`` source raising a typed
+:class:`~keystone_tpu.faults.TransientError` — retry with bounded
+exponential backoff (``KEYSTONE_SCAN_RETRY_BACKOFF``); exhaustion
+propagates the ORIGINAL exception with its original traceback, exactly
+the pre-retry behavior.
+
 Knobs: ``KEYSTONE_SCAN_PIPELINE=0`` is the kill switch (serial scan, the
 staging double buffer kept — lane placement preserved); ``KEYSTONE_SCAN_DEPTH``
 sets the buffer and per-lane staging depth (default 2; a K-lane scan keeps
@@ -58,6 +68,7 @@ when tracing is on.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -69,7 +80,11 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..faults import SCAN_STAGE, RetryBudget, retry_call
 from ..utils import env_flag as _env_flag, env_int as _env_int
+from ..utils.obs import every as _log_every
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_DEPTH = 2
 _JOIN_TIMEOUT = 5.0
@@ -174,6 +189,8 @@ class ScanStats:
     #: consumer-reported cross-mesh transfers (partial-accumulator
     #: reductions + per-block model broadcasts) attributed to this scan
     collectives: int = 0
+    #: transient-failure retries consumed from the scan's RetryBudget
+    retries: int = 0
 
 
 _CHUNK, _ERROR, _DONE = 0, 1, 2
@@ -196,12 +213,19 @@ def _producer_put(q: Queue, stop: threading.Event, stats: ScanStats, item) -> bo
 
 
 def _producer_loop(
-    source: Iterator[Any], q: Queue, stop: threading.Event, stats: ScanStats
+    source: Iterator[Any], q: Queue, stop: threading.Event, stats: ScanStats,
 ) -> None:
     """The producer thread body. A MODULE-LEVEL function on purpose: the
     thread must not hold a reference to the ScanPipeline, or an abandoned
     iterator could never be garbage-collected (the thread registry would
-    pin it) and its producer would run to exhaustion unreaped."""
+    pin it) and its producer would run to exhaustion unreaped.
+
+    Fault injection note: the ``scan.chunk`` fault point lives at the
+    :class:`~keystone_tpu.data.chunked.ChunkedDataset` seam (inside the
+    source this loop pulls), NOT here — a generator source is dead once
+    it raises, so retrying ``next(source)`` from outside would silently
+    truncate the stream; injecting (and retrying) INSIDE the source's
+    own loop keeps the generator alive across retries."""
     try:
         while not stop.is_set():
             t0 = time.perf_counter()
@@ -222,7 +246,14 @@ def _producer_loop(
             try:
                 close()
             except Exception:
-                pass
+                # a source whose close() fails mid-teardown must not kill
+                # the scan, but an injected fault vanishing here would make
+                # the chaos schedule unreadable — say what happened
+                if _log_every("scan.source_close", 30.0):
+                    logger.warning(
+                        "scan[%s]: chunk-source close() failed",
+                        stats.label, exc_info=True,
+                    )
     _producer_put(q, stop, stats, (_DONE, None))
 
 
@@ -267,6 +298,14 @@ class ScanPipeline:
         self._span = None
         self.stats = ScanStats(
             label=label, depth=self._depth, start=time.perf_counter()
+        )
+        # ONE transient-retry budget per scan: when the source is the
+        # chunk-fault injection seam (chunked._InjectedChunks) its budget
+        # is ADOPTED, so chunk-production and staging retries draw from
+        # the same bounded pool and both land in the span's retry count
+        self._retry = (
+            getattr(source, "retry_budget", None)
+            or RetryBudget(label=f"scan[{label}]")
         )
         if self._devices is not None:
             self.stats.lanes = self._lanes
@@ -329,7 +368,13 @@ class ScanPipeline:
                 if self._do_stage:
                     lane = self._seq % self._lanes
                     dev = self._devices[lane] if self._devices else None
-                    chunk, nbytes = _stage_chunk(payload, dev)
+                    # H2D staging is idempotent (device_put of the same
+                    # payload), so transient failures — injected at the
+                    # scan.stage fault point or real — retry in place
+                    chunk, nbytes = retry_call(
+                        lambda: _stage_chunk(payload, dev),
+                        self._retry, SCAN_STAGE, label=self.stats.label,
+                    )
                     self.stats.staged_bytes += nbytes
                     if self._devices is not None:
                         self.stats.lane_chunks[lane] += 1
@@ -393,6 +438,7 @@ class ScanPipeline:
             return
         self._recorded = True
         self.stats.end = time.perf_counter()
+        self.stats.retries = self._retry.attempts
         try:
             from ..obs.scan import record_scan_span
 
@@ -400,14 +446,30 @@ class ScanPipeline:
             # stamped onto it after exhaustion (record_collectives)
             self._span = record_scan_span(self.stats)
         except Exception:
-            pass
+            # span recording must never fail a scan, but losing the span
+            # silently hides exactly the evidence a chaos run needs
+            if _log_every("scan.span_record", 30.0):
+                logger.warning(
+                    "scan[%s]: failed to record scan.pipeline span",
+                    self.stats.label, exc_info=True,
+                )
 
     def __del__(self):
         try:
             if not self._closed:
                 self.close()
         except Exception:
-            pass
+            # a GC-time close failure leaves a daemon producer behind —
+            # visible at WARNING instead of vanishing (the logging itself
+            # is guarded: __del__ can run during interpreter teardown)
+            try:
+                if _log_every("scan.del_close", 30.0):
+                    logger.warning(
+                        "scan[%s]: close() failed during garbage "
+                        "collection", self.stats.label, exc_info=True,
+                    )
+            except Exception:
+                pass
 
     def __enter__(self) -> "ScanPipeline":
         return self
@@ -556,6 +618,14 @@ class ChunkPadder:
 
             return scan_lanes()
         except Exception:
+            # falling back to 1 is safe (unsharded buckets), but a mesh
+            # probe failing is news — a sharded scan would silently lose
+            # its lane alignment if this kept vanishing
+            if _log_every("scan.lane_multiple", 30.0):
+                logger.warning(
+                    "chunk bucketing: mesh lane probe failed — padding "
+                    "buckets without a lane multiple", exc_info=True,
+                )
             return 1
 
     def _run(self, chunk: Any, rows: int) -> Any:

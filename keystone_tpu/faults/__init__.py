@@ -1,0 +1,65 @@
+"""Fault-tolerant execution: deterministic fault injection and the
+recovery machinery behind it.
+
+Three pieces, used together by the chaos tests and the
+``fault_tolerance`` bench extra:
+
+* :mod:`~keystone_tpu.faults.plan` — seeded, deterministic fault
+  injection (``KEYSTONE_FAULTS`` / :func:`install`) through named
+  :func:`fault_point` hooks in the scan pipeline, the serving replicas,
+  and the AOT cache; typed errors (:class:`TransientError`,
+  :class:`ReplicaKilled`) classify what recovery applies.
+* :mod:`~keystone_tpu.faults.retry` — per-scan bounded-backoff retry of
+  transient failures (``KEYSTONE_SCAN_RETRIES``, off by default).
+* :mod:`~keystone_tpu.faults.checkpoint` — atomic on-disk snapshots of
+  the streaming-fit accumulators, so ``fit(checkpoint=dir)`` resumes a
+  killed out-of-core fit from the last completed block.
+
+Replica supervision (restart/requeue/quarantine) lives with the fleet in
+:mod:`keystone_tpu.serving.fleet`; it consumes the typed errors here.
+"""
+
+from .checkpoint import FitCheckpoint
+from .plan import (
+    AOT_READ,
+    REPLICA_BATCH,
+    SCAN_CHUNK,
+    SCAN_STAGE,
+    FatalFaultInjected,
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    ReplicaDown,
+    ReplicaKilled,
+    TransientError,
+    active_plan,
+    clear,
+    fault_point,
+    install,
+    is_transient,
+    parse_plan,
+)
+from .retry import RetryBudget, retry_call
+
+__all__ = [
+    "AOT_READ",
+    "REPLICA_BATCH",
+    "SCAN_CHUNK",
+    "SCAN_STAGE",
+    "FatalFaultInjected",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "FitCheckpoint",
+    "ReplicaDown",
+    "ReplicaKilled",
+    "RetryBudget",
+    "TransientError",
+    "active_plan",
+    "clear",
+    "fault_point",
+    "install",
+    "is_transient",
+    "parse_plan",
+    "retry_call",
+]

@@ -1,0 +1,153 @@
+"""Resumable-fit checkpoints: snapshot-able accumulator state on disk.
+
+A long out-of-core fit folds chunks into small sufficient statistics
+(:mod:`keystone_tpu.linalg.accumulators`: a Gram/cross pair, a TSQR R
+factor, Chan/Welford moments). Those states are tiny (O(d²)) and exact,
+so a fit can persist ``(state, chunk_cursor, row_cursor)`` at block
+boundaries and a killed fit can RESUME from the last completed block
+instead of rescanning — the recovery the ROADMAP's mid-fit re-planning
+item also needs.
+
+Write discipline mirrors :class:`~keystone_tpu.cost.store.ProfileStore`:
+one self-validating file per fit key (magic + sha256 over the pickled
+payload), atomic tmp-then-rename so readers see the old checkpoint XOR
+the new one, never a torn write. Loads degrade: a missing file is a
+fresh fit, a corrupt file is deleted (WARNING) and the fit starts over,
+a checkpoint written under a DIFFERENT fit key is left alone and
+ignored — resuming someone else's fit would silently fold wrong data.
+
+The state payload is pickled, which is exact for the host-numpy
+accumulators (float64 arrays round-trip bit-for-bit) — the basis of the
+resume-parity guarantee: a killed-and-resumed fit folds the identical
+state an uninterrupted fit would have.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import tempfile
+from typing import Any, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = b"KSFITCKPT1\n"
+
+
+class FitCheckpoint:
+    """One fit's resumable state under ``root``, keyed by ``key`` (the
+    fit's logical identity: solver family, λ grid, data shape/length —
+    anything that would make resuming wrong if it differed)."""
+
+    def __init__(self, root: str, key: str):
+        self.root = str(root)
+        self.key = str(key)
+        os.makedirs(self.root, exist_ok=True)
+        digest = hashlib.sha256(self.key.encode()).hexdigest()[:16]
+        self.path = os.path.join(self.root, f"fitckpt-{digest}.pkl")
+
+    # -- write -----------------------------------------------------------
+
+    def save(self, state: Any, chunk_cursor: int, row_cursor: int) -> None:
+        """Persist one completed-block snapshot atomically. ``state`` is
+        any picklable accumulator (or dict of them); ``chunk_cursor`` is
+        the number of chunks fully folded; ``row_cursor`` the rows they
+        covered (so resume can slice labels without re-measuring skipped
+        chunks)."""
+        doc = {
+            "key": self.key,
+            "chunk": int(chunk_cursor),
+            "rows": int(row_cursor),
+            "state": state,
+        }
+        payload = pickle.dumps(doc, protocol=4)
+        blob = _MAGIC + hashlib.sha256(payload).digest() + payload
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp-ckpt-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self.path)  # atomic: old XOR new, never torn
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # -- read ------------------------------------------------------------
+
+    def load(self) -> Optional[Tuple[Any, int, int]]:
+        """``(state, chunk_cursor, row_cursor)`` of the last completed
+        block, or None (missing / corrupt / foreign key). Never raises
+        for on-disk problems."""
+        try:
+            with open(self.path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError:
+            logger.warning(
+                "fit checkpoint: unreadable %s — starting fresh",
+                self.path, exc_info=True,
+            )
+            return None
+        doc = self._parse(blob)
+        if doc is None:
+            self._discard("corrupt")
+            return None
+        if doc.get("key") != self.key:
+            # hash-prefix collision or a caller pointing two different
+            # fits at one dir: never resume a foreign fit's state
+            logger.warning(
+                "fit checkpoint: %s belongs to a different fit key — "
+                "ignoring it and starting fresh", self.path,
+            )
+            return None
+        return doc["state"], int(doc["chunk"]), int(doc["rows"])
+
+    def _parse(self, blob: bytes) -> Optional[dict]:
+        if not blob.startswith(_MAGIC):
+            return None
+        body = blob[len(_MAGIC):]
+        if len(body) < 32:
+            return None
+        digest, payload = body[:32], body[32:]
+        if hashlib.sha256(payload).digest() != digest:
+            return None
+        try:
+            doc = pickle.loads(payload)
+        except Exception:
+            return None
+        if not isinstance(doc, dict) or "state" not in doc:
+            return None
+        return doc
+
+    def _discard(self, why: str) -> None:
+        logger.warning(
+            "fit checkpoint: %s entry at %s — deleting and starting fresh",
+            why, self.path,
+        )
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- lifecycle -------------------------------------------------------
+
+    def complete(self) -> None:
+        """The fit finished: remove the checkpoint so the NEXT fit under
+        this key starts fresh instead of resuming a finished pass."""
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        except OSError:
+            logger.warning(
+                "fit checkpoint: could not remove completed %s", self.path,
+                exc_info=True,
+            )
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)

@@ -1,0 +1,351 @@
+"""Deterministic, seeded fault injection: the plan and its hook.
+
+Distributed ML programs die not in the math but in the failure modes
+around it — stragglers, lost workers, restarted jobs (the Spark-perf
+study, PAPERS.md #3). This module is the chaos seam that lets the repo
+TEST those modes deterministically: named :func:`fault_point` hooks are
+instrumented into the hot paths that talk to the outside world (chunk
+production, H2D staging, replica batch execution, AOT cache reads), and
+a :class:`FaultPlan` — installed in code or parsed from the
+``KEYSTONE_FAULTS`` environment variable — decides which invocations of
+which sites raise which typed error.
+
+With no plan installed and ``KEYSTONE_FAULTS`` unset, every fault point
+is a no-op: one None-returning lookup, no locks, no logging — the hot
+path pays nothing.
+
+Plan grammar (``KEYSTONE_FAULTS``)::
+
+    plan    := clause (';' clause)*
+    clause  := site ['#' match] '=' kind ['@' hits]
+    kind    := 'transient' | 'fatal' | 'kill'
+    hits    := index (',' index)*            # exact 0-based invocation
+                                             # indices at that site
+             | 'p' RATE ['x' LIMIT] ['s' SEED]   # seeded Bernoulli per
+                                             # invocation, at most LIMIT
+                                             # faults, from SEED
+
+``site`` names an instrumented hook (see the constants below). ``#match``
+restricts the clause to invocations whose ``replica=`` context attribute
+equals ``match`` (e.g. ``replica.batch#0`` faults only replica 0's
+batches); each clause counts its MATCHING invocations independently,
+so indices are deterministic per clause. Omitting ``@hits`` means
+``@0`` — the first matching invocation.
+
+Kinds:
+
+* ``transient`` raises :class:`FaultInjected` (a :class:`TransientError`)
+  — what the retry/requeue machinery recovers from;
+* ``fatal`` raises :class:`FatalFaultInjected` — never retried, the
+  "kill this fit so resume can be tested" error;
+* ``kill`` raises :class:`ReplicaKilled` (a ``BaseException`` subclass,
+  like ``KeyboardInterrupt``) — it deliberately punches through
+  ``except Exception`` backstops to simulate a worker thread dying
+  mid-loop; only the fleet's supervisor catches it.
+
+Examples::
+
+    KEYSTONE_FAULTS="scan.chunk=transient@2,5"       # chunks 2 and 5 fault once each
+    KEYSTONE_FAULTS="scan.stage=transient@p0.2x3s7"  # ~20% of stagings, at most 3, seed 7
+    KEYSTONE_FAULTS="replica.batch#1=kill@3"         # replica 1's 4th batch kills its thread
+    KEYSTONE_FAULTS="aot.read=transient@0;scan.chunk=fatal@8"
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# -- the instrumented sites --------------------------------------------------
+
+#: chunk production inside the pipelined scan's producer thread
+SCAN_CHUNK = "scan.chunk"
+#: H2D staging of a produced chunk onto its lane device
+SCAN_STAGE = "scan.stage"
+#: one replica micro-batch execution (context attr ``replica=index``)
+REPLICA_BATCH = "replica.batch"
+#: one AOT executable-cache read (degrades to a miss on transient fault)
+AOT_READ = "aot.read"
+
+_KINDS = ("transient", "fatal", "kill")
+
+
+# -- typed errors ------------------------------------------------------------
+
+
+class TransientError(Exception):
+    """Classification base for failures worth retrying: the operation is
+    expected to succeed if re-executed (flaky I/O, a dropped connection,
+    an injected chaos fault). The recovery machinery retries ONLY errors
+    classified transient; everything else propagates untouched."""
+
+
+class FaultInjected(TransientError):
+    """A ``transient``-kind fault raised by :func:`fault_point`."""
+
+    def __init__(self, site: str, invocation: int):
+        super().__init__(
+            f"injected transient fault at {site} (invocation {invocation})"
+        )
+        self.site = site
+        self.invocation = invocation
+
+
+class FatalFaultInjected(RuntimeError):
+    """A ``fatal``-kind fault: never classified transient, never retried
+    — the way a chaos schedule kills a fit so resume can be tested."""
+
+    def __init__(self, site: str, invocation: int):
+        super().__init__(
+            f"injected fatal fault at {site} (invocation {invocation})"
+        )
+        self.site = site
+        self.invocation = invocation
+
+
+class ReplicaDown(BaseException):
+    """Base of the worker-death signals. A ``BaseException`` on purpose:
+    it must punch through the ``except Exception`` backstops between a
+    batch loop and the fleet supervisor, exactly like a real thread
+    death would bypass them. ``pending`` carries the requests the dying
+    worker leaves unanswered, for the supervisor to requeue."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.pending: Optional[list] = None
+
+
+class ReplicaKilled(ReplicaDown):
+    """A ``kill``-kind fault: the replica's thread dies here."""
+
+
+def is_transient(exc: BaseException) -> bool:
+    """The retry classification: our typed :class:`TransientError` plus
+    the stdlib families that mean "the world hiccuped" rather than "the
+    program is wrong"."""
+    return isinstance(exc, (TransientError, ConnectionError, TimeoutError))
+
+
+# -- plan --------------------------------------------------------------------
+
+
+@dataclass
+class FaultSpec:
+    """One parsed clause: which invocations of ``site`` fault, and how."""
+
+    site: str
+    kind: str
+    #: exact 0-based matching-invocation indices (None = probabilistic)
+    at: Optional[frozenset] = None
+    rate: float = 0.0
+    limit: Optional[int] = None
+    seed: int = 0
+    #: restrict to invocations whose ``replica`` context attr equals this
+    match: Optional[int] = None
+    # runtime state (reset()-able)
+    count: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+    _rng: Optional[random.Random] = field(default=None, compare=False,
+                                          repr=False)
+
+    def reset(self) -> None:
+        self.count = 0
+        self.fired = 0
+        self._rng = None
+
+    def _hit(self) -> bool:
+        i = self.count
+        self.count += 1
+        if self.at is not None:
+            if i in self.at:
+                self.fired += 1
+                return True
+            return False
+        if self.limit is not None and self.fired >= self.limit:
+            return False
+        if self._rng is None:
+            self._rng = random.Random(self.seed)
+        if self._rng.random() < self.rate:
+            self.fired += 1
+            return True
+        return False
+
+
+class FaultPlan:
+    """A parsed fault schedule. Thread-safe; each clause counts its own
+    matching invocations, so two concurrent consumers of one plan see a
+    deterministic global fault schedule (the interleaving decides which
+    consumer draws each faulting invocation, but the total count and the
+    per-clause indices are fixed)."""
+
+    def __init__(self, specs: List[FaultSpec]):
+        self._lock = threading.Lock()
+        self._by_site: Dict[str, List[FaultSpec]] = {}
+        for s in specs:
+            self._by_site.setdefault(s.site, []).append(s)
+        #: injected-fault counts per site, for tests and reports
+        self.injected: Dict[str, int] = {}
+
+    @property
+    def sites(self) -> Tuple[str, ...]:
+        return tuple(self._by_site)
+
+    def reset(self) -> None:
+        """Zero every clause's invocation/fired counters and re-seed."""
+        with self._lock:
+            for specs in self._by_site.values():
+                for s in specs:
+                    s.reset()
+            self.injected.clear()
+
+    def check(self, site: str, attrs: dict) -> Optional[str]:
+        """Count one invocation of ``site``; return the fault kind to
+        raise, or None. The no-plan-for-this-site path takes no lock."""
+        specs = self._by_site.get(site)
+        if specs is None:
+            return None
+        with self._lock:
+            for s in specs:
+                if s.match is not None and attrs.get("replica") != s.match:
+                    continue
+                if s._hit():
+                    self.injected[site] = self.injected.get(site, 0) + 1
+                    return s.kind
+        return None
+
+
+def parse_plan(text: str) -> FaultPlan:
+    """Parse the ``KEYSTONE_FAULTS`` grammar (module docstring). Raises
+    :class:`ValueError` naming the offending clause — a typo'd chaos
+    schedule must fail loudly, not silently inject nothing."""
+    specs: List[FaultSpec] = []
+    for clause in text.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            site_part, _, rhs = clause.partition("=")
+            if not _ or not site_part or not rhs:
+                raise ValueError("expected site=kind[@hits]")
+            site_part = site_part.strip()
+            match: Optional[int] = None
+            if "#" in site_part:
+                site_part, m = site_part.split("#", 1)
+                match = int(m)
+            kind, _, hits = rhs.strip().partition("@")
+            kind = kind.strip()
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"unknown kind {kind!r} (use {'|'.join(_KINDS)})"
+                )
+            spec = FaultSpec(site=site_part, kind=kind, match=match)
+            hits = hits.strip()
+            if not hits:
+                spec.at = frozenset((0,))
+            elif hits.startswith("p"):
+                body = hits[1:]
+                seed = 0
+                limit: Optional[int] = None
+                if "s" in body:
+                    body, s = body.split("s", 1)
+                    seed = int(s)
+                if "x" in body:
+                    body, x = body.split("x", 1)
+                    limit = int(x)
+                rate = float(body)
+                if not 0.0 < rate <= 1.0:
+                    raise ValueError(f"rate {rate} outside (0, 1]")
+                spec.rate, spec.limit, spec.seed = rate, limit, seed
+            else:
+                spec.at = frozenset(int(i) for i in hits.split(","))
+            specs.append(spec)
+        except ValueError as e:
+            raise ValueError(
+                f"bad KEYSTONE_FAULTS clause {clause!r}: {e}"
+            ) from None
+    if not specs:
+        raise ValueError(f"empty fault plan: {text!r}")
+    return FaultPlan(specs)
+
+
+# -- installation + the hook -------------------------------------------------
+
+_installed: Optional[FaultPlan] = None
+_env_plan: Optional[FaultPlan] = None
+_env_raw: Optional[str] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` process-wide (wins over ``KEYSTONE_FAULTS``)."""
+    global _installed
+    _installed = plan
+    return plan
+
+
+def clear() -> None:
+    """Remove any installed plan AND forget the cached env parse (so a
+    test that mutated ``KEYSTONE_FAULTS`` starts the next schedule with
+    fresh invocation counters)."""
+    global _installed, _env_plan, _env_raw
+    _installed = None
+    _env_plan = None
+    _env_raw = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The plan in force: the installed one, else the ``KEYSTONE_FAULTS``
+    parse (cached on the raw string, so invocation counters persist for
+    the life of the value — the determinism contract)."""
+    if _installed is not None:
+        return _installed
+    raw = os.environ.get("KEYSTONE_FAULTS")
+    if not raw:
+        return None
+    global _env_plan, _env_raw
+    if raw != _env_raw:
+        _env_plan = parse_plan(raw)
+        _env_raw = raw
+        logger.warning(
+            "fault injection ACTIVE: KEYSTONE_FAULTS=%r (sites: %s)",
+            raw, ", ".join(_env_plan.sites),
+        )
+    return _env_plan
+
+
+def fault_point(site: str, **attrs) -> None:
+    """THE hook: a no-op without a plan; with one, raises the scheduled
+    typed error for this invocation of ``site``. ``attrs`` is matching
+    context (``replica=index``) and lands on the ``fault.inject`` trace
+    instant."""
+    plan = active_plan()
+    if plan is None:
+        return
+    kind = plan.check(site, attrs)
+    if kind is None:
+        return
+    invocation = plan.injected.get(site, 1) - 1
+    logger.warning(
+        "fault injected: site=%s kind=%s attrs=%s", site, kind, attrs
+    )
+    try:
+        from ..obs.tracer import current as _trace_current
+
+        tracer = _trace_current()
+        if tracer is not None:
+            tracer.instant(
+                "fault.inject", op_type="FaultPlan",
+                site=site, kind=kind, **attrs,
+            )
+    except Exception:
+        pass
+    if kind == "kill":
+        raise ReplicaKilled(f"injected kill at {site}")
+    if kind == "fatal":
+        raise FatalFaultInjected(site, invocation)
+    raise FaultInjected(site, invocation)

@@ -1,0 +1,134 @@
+"""Bounded-backoff retry of transient failures, with a per-scan budget.
+
+Each scan layer owns ONE :class:`RetryBudget`, bounded per scan: the
+chunk-fault seam and the H2D staging ring share a single budget (the
+pipeline adopts the seam's), and a re-callable source's per-index
+regeneration (``ChunkedDataset.from_chunk_fn``) draws its own — so a
+scan whose source is genuinely broken cannot retry forever; exhaustion
+re-raises the ORIGINAL exception with its original traceback, exactly
+what the un-retried path propagated before this module existed.
+
+Off by default: the budget reads ``KEYSTONE_SCAN_RETRIES`` (0 = no
+retries, today's fail-fast behavior). ``KEYSTONE_SCAN_RETRY_BACKOFF``
+sets the base backoff in seconds (default 0.05); each attempt doubles
+it, capped at :data:`MAX_BACKOFF_S`. Every retry logs a rate-limited
+WARNING and lands a ``retry.attempt`` instant in the trace.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .plan import fault_point, is_transient
+
+logger = logging.getLogger(__name__)
+
+MAX_BACKOFF_S = 2.0
+
+
+def retry_budget_from_env() -> int:
+    """KEYSTONE_SCAN_RETRIES: transient retries allowed per scan
+    (default 0 — recovery is opt-in)."""
+    try:
+        return max(0, int(os.environ.get("KEYSTONE_SCAN_RETRIES", "0")))
+    except ValueError:
+        logger.warning(
+            "ignoring non-integer KEYSTONE_SCAN_RETRIES=%r",
+            os.environ.get("KEYSTONE_SCAN_RETRIES"),
+        )
+        return 0
+
+
+def retry_backoff_from_env() -> float:
+    try:
+        return max(
+            0.0,
+            float(os.environ.get("KEYSTONE_SCAN_RETRY_BACKOFF", "0.05")),
+        )
+    except ValueError:
+        return 0.05
+
+
+class RetryBudget:
+    """A thread-safe bounded retry pool shared by every stage of one
+    scan (the producer thread and the consumer's staging ring both draw
+    from it)."""
+
+    def __init__(
+        self,
+        budget: Optional[int] = None,
+        backoff_s: Optional[float] = None,
+        label: str = "scan",
+    ):
+        self.budget = retry_budget_from_env() if budget is None else budget
+        self.backoff_s = (
+            retry_backoff_from_env() if backoff_s is None else backoff_s
+        )
+        self.label = label
+        self.attempts = 0  # total retries consumed (the span counter)
+        self._lock = threading.Lock()
+
+    def consume(self, exc: BaseException, site: str) -> Optional[float]:
+        """One retry decision: returns the backoff delay in seconds when
+        ``exc`` is transient and budget remains, else None (caller
+        re-raises the original)."""
+        if not is_transient(exc):
+            return None
+        with self._lock:
+            if self.attempts >= self.budget:
+                return None
+            self.attempts += 1
+            attempt = self.attempts
+        delay = min(self.backoff_s * (2 ** (attempt - 1)), MAX_BACKOFF_S)
+        from ..utils.obs import every
+
+        if every(f"faults.retry:{site}", 10.0):
+            logger.warning(
+                "%s: transient failure at %s — retry %d/%d in %.3fs (%s)",
+                self.label, site, attempt, self.budget, delay, exc,
+            )
+        try:
+            from ..obs.tracer import current as _trace_current
+
+            tracer = _trace_current()
+            if tracer is not None:
+                tracer.instant(
+                    "retry.attempt", op_type="RetryBudget",
+                    site=site, attempt=attempt, budget=self.budget,
+                    delay_s=round(delay, 4), label=self.label,
+                )
+        except Exception:
+            pass
+        return delay
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    budget: RetryBudget,
+    site: str,
+    inject: bool = True,
+    **attrs,
+) -> Any:
+    """Run ``fn`` under the transient-retry discipline: an injected fault
+    at ``site`` (when ``inject``) or a transient error from ``fn`` itself
+    retries with backoff while the scan's budget lasts; anything else —
+    and exhaustion — re-raises the original exception with its original
+    traceback. ``fn`` MUST be safe to re-execute (idempotent production:
+    a chunk_fn(i) regeneration, a device_put)."""
+    while True:
+        try:
+            if inject:
+                fault_point(site, **attrs)
+            return fn()
+        except StopIteration:
+            raise
+        except Exception as e:
+            delay = budget.consume(e, site)
+            if delay is None:
+                raise
+            if delay:
+                time.sleep(delay)

@@ -17,10 +17,13 @@ matters at fit time.
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
 
 from ...data.dataset import Dataset
 from ...linalg import solve_blockwise_l2, solve_least_squares
@@ -70,29 +73,57 @@ class LinearMapEstimator(LabelEstimator, CostModel):
     ΣAᵀy with centering applied algebraically at the solve) and attaches
     the state to the fitted :class:`LinearMapper` — the handle
     ``FittedPipeline.absorb`` folds appended chunks into for an
-    O(new chunks) incremental refit."""
+    O(new chunks) incremental refit.
+
+    ``checkpoint=dir`` makes a chunked fit RESUMABLE: the same
+    accumulator state (plus a chunk/row cursor) persists atomically to
+    ``dir`` every ``checkpoint_every`` chunks
+    (:class:`~keystone_tpu.faults.FitCheckpoint`), so a killed fit
+    re-run with the same arguments resumes from the last completed
+    block — folding bit-identical solver state to an uninterrupted fit
+    — instead of rescanning from chunk zero. The checkpoint is removed
+    when the fit completes."""
 
     supports_streaming = True
 
-    def __init__(self, lam: Optional[float] = None, snapshot: bool = False):
+    def __init__(
+        self,
+        lam: Optional[float] = None,
+        snapshot: bool = False,
+        checkpoint: Optional[str] = None,
+        checkpoint_every: int = 1,
+    ):
         self.lam = lam
         self.snapshot = snapshot
+        self.checkpoint = checkpoint
+        self.checkpoint_every = checkpoint_every
 
     # -- sweep grid hooks (keystone_tpu/sweep/) -------------------------
 
     def grid_family(self):
         """Estimators of one sweep whose key matches fit as a group; λ is
-        the swept axis, so it is excluded from the key."""
-        return ("gram_ne", bool(self.snapshot))
+        the swept axis, so it is excluded from the key. The checkpoint
+        dir is part of the identity — a sweep's shared accumulation pass
+        would otherwise silently drop a member's resume contract."""
+        return ("gram_ne", bool(self.snapshot), self.checkpoint)
 
     @staticmethod
     def fit_lambda_grid(estimators: Sequence["LinearMapEstimator"],
-                        data, labels: Dataset) -> List[LinearMapper]:
+                        data, labels: Dataset,
+                        checkpoint: Optional[str] = None,
+                        checkpoint_every: int = 1) -> List[LinearMapper]:
         """Fit a λ-only grid from ONE accumulation pass: the Gram and
         cross products don't depend on λ, so the grid costs
         O(prefix + n·d² + G·d³) instead of G full fits. Every returned
         mapper carries its own snapshot of the shared state (λ recorded),
-        so any of them can later ``absorb`` appended chunks."""
+        so any of them can later ``absorb`` appended chunks.
+
+        With ``checkpoint``, the accumulation over a chunked ``data``
+        persists ``(state, chunk cursor, row cursor)`` to that directory
+        every ``checkpoint_every`` chunks and RESUMES from the last
+        completed block on re-run — the fold is associative and the
+        state is exact host float64, so the resumed accumulator is
+        bit-identical to an uninterrupted pass."""
         from ...data.chunked import ChunkedDataset
         from ...linalg.accumulators import GramSolverState
         from ...utils.timing import phase
@@ -103,16 +134,42 @@ class LinearMapEstimator(LabelEstimator, CostModel):
                 y = jnp.asarray(
                     Dataset.of(labels).to_array(), dtype=jnp.float32
                 )
+                ckpt = None
+                start_chunk = 0
                 offset = 0
-                for chunk in data.raw_chunks():
+                if checkpoint is not None:
+                    from ...faults import FitCheckpoint
+
+                    lams = [float(e.lam or 0.0) for e in estimators]
+                    key = (
+                        f"gram_ne|n={len(data)}"
+                        f"|y={tuple(int(s) for s in y.shape)}|lams={lams}"
+                    )
+                    ckpt = FitCheckpoint(checkpoint, key)
+                    loaded = ckpt.load()
+                    if loaded is not None:
+                        state, start_chunk, offset = loaded
+                        logger.info(
+                            "fit checkpoint: resuming Gram accumulation "
+                            "at chunk %d (row %d) from %s",
+                            start_chunk, offset, ckpt.path,
+                        )
+                every = max(1, int(checkpoint_every))
+                i = start_chunk
+                for chunk in data.raw_chunks(skip=start_chunk):
                     rows = int(chunk.shape[0])
                     state.update(chunk, y[offset : offset + rows])
                     offset += rows
+                    i += 1
+                    if ckpt is not None and i % every == 0:
+                        ckpt.save(state, i, offset)
                 if offset != y.shape[0]:
                     raise ValueError(
                         f"chunked features have {offset} rows, labels "
                         f"{y.shape[0]}"
                     )
+                if ckpt is not None:
+                    ckpt.complete()
             else:
                 state.update(
                     Dataset.of(data).to_array(),
@@ -132,8 +189,14 @@ class LinearMapEstimator(LabelEstimator, CostModel):
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         from ...data.chunked import ChunkedDataset
 
-        if self.snapshot:
-            return LinearMapEstimator.fit_lambda_grid([self], data, labels)[0]
+        if self.snapshot or self.checkpoint:
+            # the checkpointed fit rides the same accumulator path the
+            # snapshot fit uses — the state on disk IS the snapshot
+            return LinearMapEstimator.fit_lambda_grid(
+                [self], data, labels,
+                checkpoint=self.checkpoint,
+                checkpoint_every=self.checkpoint_every,
+            )[0]
         if isinstance(data, ChunkedDataset):
             return self._fit_streaming(data, labels)
         A = shard_batch(data.to_array().astype(jnp.float32))
@@ -490,17 +553,32 @@ class TSQRLeastSquaresEstimator(LabelEstimator, CostModel):
     Chunked inputs stream through :func:`linalg.tsqr.tsqr_r_streaming`
     (per-lane R folds, one cross-mesh gather at finalize), so the exact
     QR solve is available out-of-core too.
+
+    ``checkpoint=dir`` makes the chunked fit resumable: it runs the
+    sequential :class:`~keystone_tpu.linalg.accumulators.TsqrRState`
+    recurrence (restartable by construction) instead of the laned fold,
+    persists the R state + column means + chunk cursor to ``dir`` every
+    ``checkpoint_every`` chunks, and a killed fit re-run resumes from
+    the last completed block — the means pass is checkpointed too, so
+    resume re-reads NO already-folded chunk.
     """
 
     supports_streaming = True
 
-    def __init__(self, lam: float = 0.0):
+    def __init__(
+        self,
+        lam: float = 0.0,
+        checkpoint: Optional[str] = None,
+        checkpoint_every: int = 1,
+    ):
         self.lam = lam
+        self.checkpoint = checkpoint
+        self.checkpoint_every = checkpoint_every
 
     # -- sweep grid hooks (keystone_tpu/sweep/) -------------------------
 
     def grid_family(self):
-        return ("tsqr",)
+        return ("tsqr", self.checkpoint)
 
     @staticmethod
     def fit_lambda_grid(
@@ -597,6 +675,92 @@ class TSQRLeastSquaresEstimator(LabelEstimator, CostModel):
         W = self._solve_from_r(tsqr_r(aug), d)
         return LinearMapper(W, b=y_mean, feature_mean=a_mean)
 
+    def _fit_streaming_checkpointed(self, data, labels: Dataset) -> LinearMapper:
+        """The resumable out-of-core TSQR fit: sequential
+        :class:`TsqrRState` fold (exactly the streaming recurrence, so
+        restart-from-R is restart-from-the-math) with the column means
+        and the chunk/row cursor persisted alongside the R factor. The
+        √λ rows fold only at the end — they must never be inside a
+        checkpointed prefix."""
+        from ...faults import FitCheckpoint
+        from ...linalg.accumulators import TsqrRState
+        from ...linalg.bcd import stream_column_means
+        from ...linalg.tsqr import _qr_fold
+        from ...utils.timing import phase
+
+        y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        key = (
+            f"tsqr|n={len(data)}|y={tuple(int(s) for s in y.shape)}"
+            f"|lam={float(self.lam or 0.0)}"
+        )
+        ckpt = FitCheckpoint(self.checkpoint, key)
+        loaded = ckpt.load()
+        if loaded is not None:
+            doc, start_chunk, offset = loaded
+            a_mean = jnp.asarray(doc["a_mean"])
+            y_mean = jnp.asarray(doc["y_mean"])
+            state = doc["state"]
+            logger.info(
+                "fit checkpoint: resuming TSQR fold at chunk %d (row %d) "
+                "from %s", start_chunk, offset, ckpt.path,
+            )
+        else:
+            with phase("tsqr_ls.stream_center") as out:
+                a_mean, n = stream_column_means(data.raw_chunks)
+                if n != y.shape[0]:
+                    raise ValueError(
+                        f"chunked features have {n} rows, labels "
+                        f"{y.shape[0]}"
+                    )
+                y_mean = jnp.mean(y, axis=0)
+                out.append(y_mean)
+            state = TsqrRState()
+            start_chunk, offset = 0, 0
+            # block 0's checkpoint carries the means: a fit killed during
+            # the fold must not re-pay the centering pass on resume
+            ckpt.save(self._ckpt_doc(a_mean, y_mean, state), 0, 0)
+        d = int(a_mean.shape[0])
+        k = int(y.shape[1])
+        every = max(1, int(self.checkpoint_every))
+        with phase("tsqr_ls.stream_solve") as out:
+            i = start_chunk
+            for chunk in data.raw_chunks(skip=start_chunk):
+                chunk = jnp.asarray(chunk, dtype=jnp.float32)
+                rows = int(chunk.shape[0])
+                state.update(
+                    jnp.concatenate(
+                        [chunk - a_mean, y[offset : offset + rows] - y_mean],
+                        axis=1,
+                    )
+                )
+                offset += rows
+                i += 1
+                if i % every == 0:
+                    ckpt.save(self._ckpt_doc(a_mean, y_mean, state), i, offset)
+            if offset != y.shape[0]:
+                raise ValueError(
+                    f"chunked features have {offset} rows, labels "
+                    f"{y.shape[0]}"
+                )
+            R = state.finalize()
+            reg = self._reg_rows(d, k)
+            if reg is not None:
+                R = _qr_fold(R, reg)
+            W = self._solve_from_r(R, d)
+            out.append(W)
+        ckpt.complete()
+        return LinearMapper(W, b=y_mean, feature_mean=a_mean)
+
+    @staticmethod
+    def _ckpt_doc(a_mean, y_mean, state):
+        import numpy as np
+
+        return {
+            "a_mean": np.asarray(a_mean),
+            "y_mean": np.asarray(y_mean),
+            "state": state.snapshot(),
+        }
+
     def _fit_streaming(self, data, labels: Dataset) -> LinearMapper:
         """Means pass, then centered augmented chunks through the laned
         streaming TSQR; the √λ regularization rows ride as a final chunk
@@ -604,6 +768,9 @@ class TSQRLeastSquaresEstimator(LabelEstimator, CostModel):
         from ...linalg.bcd import stream_column_means
         from ...linalg.tsqr import tsqr_r_streaming
         from ...utils.timing import phase
+
+        if self.checkpoint:
+            return self._fit_streaming_checkpointed(data, labels)
 
         y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
         with phase("tsqr_ls.stream_center") as out:

@@ -52,6 +52,10 @@ def record_scan_span(stats):
         "staged_bytes": stats.staged_bytes,
         "occupancy_max": stats.occupancy_max,
     }
+    if getattr(stats, "retries", 0):
+        # transient-failure retries the scan's budget absorbed — stamped
+        # only when nonzero so fault-free traces keep their schema
+        attrs["retries"] = stats.retries
     if stats.lanes > 1:
         attrs.update(
             lanes=stats.lanes,

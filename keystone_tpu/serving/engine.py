@@ -38,6 +38,7 @@ from .replica import (
     check_swap_contract,
     compile_pipeline,
     serving_contract,
+    settle_future,
 )
 
 logger = logging.getLogger(__name__)
@@ -215,14 +216,43 @@ class ServingEngine:
             if warmup or warmup is None:
                 self.warm_up(required=warmup is True)
             self._thread = threading.Thread(
-                target=self._replica.serve_forever,
-                args=(_GatherSource(self),),
+                target=self._worker_main,
                 name="keystone-serving-worker",
                 daemon=True,
             )
             self._thread.start()
             self._ran = True
         return self
+
+    def _worker_main(self) -> None:
+        """The worker thread body. The single-worker engine has no
+        supervisor, so a loop-escaping death (an injected
+        :class:`~keystone_tpu.faults.ReplicaKilled`, interpreter
+        teardown) must at least fail the queue typed instead of
+        stranding every queued future forever."""
+        try:
+            self._replica.serve_forever(_GatherSource(self))
+        except BaseException as e:  # noqa: BLE001 — last-resort backstop
+            logger.exception(
+                "serving engine: worker thread died — closing admission "
+                "and failing queued requests (a ServingFleet would have "
+                "restarted it)"
+            )
+            try:
+                # close FIRST: with no consumer left, a later submit
+                # would strand its future and a drain-shutdown would
+                # deadlock on queue.join() — the _admit_lock ordering
+                # guarantees every request either lands before this flip
+                # (swept below) or is typed-refused at submit
+                with self._admit_lock:
+                    self._closed = True
+                for r in getattr(e, "pending", None) or []:
+                    settle_future(
+                        r.future, EngineStopped("engine worker died")
+                    )
+                self._reject_queued("engine worker died")
+            except Exception:
+                pass
 
     def swap(self, fitted: FittedPipeline, *, warmup: Optional[bool] = None) -> int:
         """Atomically replace the served model with ``fitted`` — the

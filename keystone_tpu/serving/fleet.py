@@ -29,6 +29,21 @@ pipeline has ever exported per the AOT cache's bucket-signature manifest
 (:mod:`keystone_tpu.compile.manifest`), so a fresh fleet against a warm
 shared cache directory boots with zero traces and zero cold
 first-requests.
+
+**Replica supervision** (default on): every replica thread runs under a
+supervisor. A worker that dies — an injected
+:class:`~keystone_tpu.faults.ReplicaKilled`, a real crash — or that
+trips the consecutive-batch-failure circuit breaker
+(:class:`~.replica.ReplicaQuarantined`) has its queued AND in-flight
+requests requeued to live peers with their original deadlines (a
+request the learned service estimate says can no longer make it is
+answered with the typed ``Shed``, never silently expired), and is
+restarted up to a per-replica restart budget. ``restarts``,
+``requeues`` and ``quarantined`` land in the metrics;
+``fault.replica_down`` / ``fault.replica_restart`` instants land in the
+trace. Shutdown is bounded: a wedged replica is joined with a timeout,
+logged at WARNING, and abandoned — its work is failed typed and the
+final sweep still answers every admitted request.
 """
 
 from __future__ import annotations
@@ -39,6 +54,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, List, Optional, Sequence
 
+from ..faults import ReplicaKilled
 from ..obs.tracer import current as _trace_current
 from ..workflow.pipeline import FittedPipeline
 from .batching import BucketPolicy
@@ -46,10 +62,12 @@ from .errors import CanaryMismatch, EngineStopped
 from .metrics import MetricsRegistry
 from .replica import (
     Replica,
+    ReplicaQuarantined,
     _Request,
     check_swap_contract,
     compile_pipeline,
     serving_contract,
+    settle_future,
 )
 from .scheduler import FleetScheduler
 
@@ -59,6 +77,13 @@ logger = logging.getLogger(__name__)
 #: foreign process may have exported a full-dataset apply shape; warming
 #: it would allocate that much zeros on every boot)
 _MAX_WARM_ELEMENTS = 1 << 24
+
+#: shutdown never blocks forever on a wedged replica: seconds to wait
+#: for the drain to go idle, and per-thread join budget after stop —
+#: a thread that misses either is logged at WARNING and abandoned
+#: (daemon), and its remaining work is failed typed
+_DRAIN_TIMEOUT_S = 60.0
+_JOIN_TIMEOUT_S = 10.0
 
 
 class ServingFleet:
@@ -78,6 +103,16 @@ class ServingFleet:
     steal:
         Work-stealing rebalance between per-replica queues (on by
         default; off pins every request to its admitted queue).
+    supervise:
+        Replica supervision (on by default): a replica whose thread dies
+        — or trips the ``quarantine_after`` consecutive-batch-failure
+        circuit breaker — has its queued and in-flight requests requeued
+        to peers WITH DEADLINES INTACT (unmeetable ones get the typed
+        ``Shed``) and is restarted up to ``max_restarts`` times, counted
+        in the ``restarts``/``requeues``/``quarantined`` metrics and
+        ``fault.*`` trace instants. ``supervise=False`` still requeues a
+        dead replica's work (nothing is ever silently stranded) but
+        never restarts it.
     """
 
     def __init__(
@@ -94,6 +129,11 @@ class ServingFleet:
         log_interval_s: float = 10.0,
         devices: Optional[Sequence[Any]] = None,
         steal: bool = True,
+        supervise: bool = True,
+        max_restarts: int = 2,
+        quarantine_after: int = 3,
+        join_timeout_s: float = _JOIN_TIMEOUT_S,
+        drain_timeout_s: float = _DRAIN_TIMEOUT_S,
     ):
         from ..parallel.placement import replica_devices
 
@@ -129,6 +169,9 @@ class ServingFleet:
                 device=self._devices[i],
                 span_name="serve.replica",
                 log_interval_s=log_interval_s,
+                # the breaker only makes sense with a supervisor to
+                # catch it and restart the worker
+                quarantine_after=quarantine_after if supervise else 0,
             )
             for i in range(n)
         ]
@@ -145,6 +188,16 @@ class ServingFleet:
         # WITHOUT the lifecycle lock so shutdown is never blocked on a
         # quiet fleet's canary timeout)
         self._swap_lock = threading.Lock()
+        # supervision state has its OWN lock: the supervisor runs in the
+        # DYING replica's thread, which shutdown (holding the lifecycle
+        # lock) may be joining — taking the lifecycle lock there would
+        # deadlock the whole stop path
+        self._supervise_lock = threading.Lock()
+        self._supervise = bool(supervise)
+        self._max_restarts = max_restarts if supervise else 0
+        self._restart_counts = [0] * n
+        self._join_timeout_s = float(join_timeout_s)
+        self._drain_timeout_s = float(drain_timeout_s)
         self._threads: List[threading.Thread] = []
         self._closed = False
         self._ran = False
@@ -287,16 +340,112 @@ class ServingFleet:
             if warmup or warmup is None:
                 self.warm_up(required=warmup is True)
             for rep in self._replicas:
-                t = threading.Thread(
-                    target=rep.serve_forever,
-                    args=(self._scheduler,),
-                    name=f"keystone-serving-replica-{rep.index}",
-                    daemon=True,
-                )
-                t.start()
-                self._threads.append(t)
+                self._spawn_replica_thread(rep)
             self._ran = True
         return self
+
+    def _spawn_replica_thread(self, rep: Replica) -> threading.Thread:
+        attempt = self._restart_counts[rep.index]
+        t = threading.Thread(
+            target=self._run_replica,
+            args=(rep,),
+            name=(
+                f"keystone-serving-replica-{rep.index}"
+                + (f"-r{attempt}" if attempt else "")
+            ),
+            daemon=True,
+        )
+        with self._supervise_lock:
+            self._threads.append(t)
+        t.start()
+        return t
+
+    # -- replica supervision ---------------------------------------------
+
+    def _run_replica(self, rep: Replica) -> None:
+        """Every replica thread's real target: the loop plus the
+        supervisor. A loop that exits with ANY ``BaseException`` — an
+        injected :class:`ReplicaKilled`, the quarantine breaker, a truly
+        unexpected death — is treated as a down worker: its queued and
+        in-flight requests are requeued to peers (deadlines intact) and
+        it restarts within the restart budget."""
+        try:
+            rep.serve_forever(self._scheduler)
+        except BaseException as e:  # noqa: BLE001 — the supervision seam
+            try:
+                self._on_replica_down(rep, e)
+            except Exception:
+                logger.exception(
+                    "fleet supervisor failed for replica %s", rep.index
+                )
+
+    def _on_replica_down(self, rep: Replica, exc: BaseException) -> None:
+        pending = getattr(exc, "pending", None) or []
+        quarantined = isinstance(exc, ReplicaQuarantined)
+        killed = isinstance(exc, ReplicaKilled)
+        kind = (
+            "quarantined" if quarantined
+            else "killed" if killed
+            else "died"
+        )
+        with self._supervise_lock:
+            used = self._restart_counts[rep.index]
+            will_restart = (
+                not self._closed and used < self._max_restarts
+            )
+            if quarantined:
+                self._metrics.inc("quarantined")
+            # a permanently-down replica stops receiving admissions; a
+            # restarting one keeps its slot live (requeue then retries
+            # locally when there is no peer — the 1-replica fleet)
+            self._scheduler.set_active(rep.index, will_restart)
+            moved = 0
+            if pending:
+                moved += self._scheduler.requeue_batch(
+                    pending, rep,
+                    cause=exc if isinstance(exc, Exception) else None,
+                )
+            moved += self._scheduler.requeue_replica(
+                rep.index, keep_if_no_peer=will_restart
+            )
+            logger.warning(
+                "fleet: replica %s %s (%s) — requeued %d request(s); "
+                "restart %s (budget %d/%d used)",
+                rep.index, kind, exc, moved,
+                "scheduled" if will_restart else "refused",
+                used, self._max_restarts,
+            )
+            tracer = _trace_current()
+            if tracer is not None:
+                tracer.instant(
+                    "fault.replica_down", op_type="ServingFleet",
+                    replica=rep.index, kind=kind, requeued=moved,
+                    restarting=will_restart,
+                )
+            if will_restart:
+                self._restart_counts[rep.index] = used + 1
+                self._metrics.inc("restarts")
+                rep.consecutive_failures = 0
+            elif not self._scheduler.any_active():
+                failed = self._scheduler.fail_remaining(
+                    "every replica is down and the restart budget is "
+                    "exhausted"
+                )
+                if failed:
+                    logger.warning(
+                        "fleet: no live replicas remain — failed %d "
+                        "queued request(s)", failed,
+                    )
+        if will_restart:
+            # spawn OUTSIDE the supervise lock (it re-takes it to
+            # register the thread)
+            self._spawn_replica_thread(rep)
+            tracer = _trace_current()
+            if tracer is not None:
+                tracer.instant(
+                    "fault.replica_restart", op_type="ServingFleet",
+                    replica=rep.index, attempt=used + 1,
+                )
 
     def drain(self) -> None:
         """Stop admitting, answer every queued request, stop all workers."""
@@ -305,21 +454,60 @@ class ServingFleet:
     def shutdown(self, drain: bool = True) -> None:
         """Stop the fleet. ``drain=True`` answers queued requests first;
         ``drain=False`` fails them with :class:`EngineStopped`.
-        Idempotent and safe from multiple threads."""
+        Idempotent and safe from multiple threads.
+
+        Never blocks forever: the drain and every thread join are
+        bounded (``drain_timeout_s`` / ``join_timeout_s``). A replica
+        that wedges — a hung host callback, a stuck device — is logged
+        at WARNING and abandoned (its thread is a daemon), its in-flight
+        requests are failed typed, and the final ``fail_remaining``
+        sweep still answers everything queued, so no admitted request is
+        ever left without an answer."""
         with self._lifecycle_lock:
             self._closed = True
             self._scheduler.close()
-            if not self._threads:
+            with self._supervise_lock:
+                started = bool(self._threads)
+            if not started:
                 self._scheduler.fail_remaining(
                     "fleet is shut down" if self._ran else "fleet never started"
                 )
                 return
             if drain:
-                self._scheduler.wait_idle()
+                if not self._scheduler.wait_idle(
+                    timeout=self._drain_timeout_s
+                ):
+                    logger.warning(
+                        "fleet shutdown: drain did not go idle within "
+                        "%.1fs (wedged replica?) — failing the remaining "
+                        "work instead of blocking forever",
+                        self._drain_timeout_s,
+                    )
             self._scheduler.stop()
-            for t in self._threads:
-                t.join()
-            self._threads = []
+            with self._supervise_lock:
+                threads, self._threads = self._threads, []
+            for t in threads:
+                t.join(timeout=self._join_timeout_s)
+                if t.is_alive():
+                    logger.warning(
+                        "fleet shutdown: thread %s did not exit within "
+                        "%.1fs — abandoning it (daemon) and failing its "
+                        "remaining work", t.name, self._join_timeout_s,
+                    )
+            # a wedged replica's in-flight batch would otherwise hang
+            # its callers: answer those futures typed (a late real
+            # result loses the set-once race harmlessly)
+            for rep in self._replicas:
+                batch = rep.current_batch
+                if batch:
+                    for r in batch:
+                        settle_future(
+                            r.future,
+                            EngineStopped(
+                                "fleet shut down while this request's "
+                                "replica was wedged"
+                            ),
+                        )
             # admission-vs-close is atomic in the scheduler, so nothing
             # can land after this point; the sweep is the belt-and-braces
             # guarantee no admitted request is ever left unanswered

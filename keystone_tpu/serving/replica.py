@@ -38,6 +38,12 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..faults import (
+    REPLICA_BATCH,
+    ReplicaDown,
+    fault_point,
+    is_transient,
+)
 from ..obs.tracer import current as _trace_current
 from ..utils import timing
 from ..workflow.pipeline import FittedPipeline, NotTraceableError
@@ -51,12 +57,56 @@ logger = logging.getLogger(__name__)
 STOP = object()
 
 
+class ReplicaQuarantined(ReplicaDown):
+    """The circuit breaker tripped: this replica failed
+    ``quarantine_after`` consecutive batches, so its loop exits and the
+    fleet supervisor takes over (requeue its work, restart it within the
+    restart budget). A ``BaseException`` like its base — it must pass the
+    worker loop's ``except Exception`` backstop."""
+
+
+class _TransientBatchFault(Exception):
+    """Internal signal: a batch failed for a TRANSIENT reason (injected
+    chaos fault, flaky device I/O) — its unanswered requests should be
+    requeued to peers rather than failed, because a retry elsewhere is
+    expected to succeed. ``pending`` is those requests, ``cause`` the
+    original error."""
+
+    def __init__(self, cause: BaseException, pending: list):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.pending = pending
+
+
+def settle_future(fut: Future, exc: BaseException) -> bool:
+    """Answer a request future with ``exc`` regardless of whether it is
+    still pending or already marked running (popped into a batch that
+    never finished). Returns True when this call delivered the answer."""
+    if fut.done():
+        return False
+    try:
+        try:
+            live = fut.set_running_or_notify_cancel()
+        except Exception:
+            live = True  # already RUNNING: settle directly
+        if not live:
+            return False  # cancelled by the caller
+        fut.set_exception(exc)
+        return True
+    except Exception:
+        return False  # lost a race with the real answer — fine
+
+
 @dataclass
 class _Request:
     datum: Any
     deadline: Optional[float]  # time.monotonic() timestamp, or None
     enqueued: float
     future: Future = field(default_factory=Future)
+    #: times this request has been requeued off a failed/dead replica —
+    #: bounds the reroute loop for deadline-less requests, which the
+    #: shed check can never retire
+    hops: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -218,6 +268,7 @@ class Replica:
         device: Any = None,
         span_name: str = "serve.replica",
         log_interval_s: float = 10.0,
+        quarantine_after: int = 0,
     ):
         #: fleet position, or None for a single-worker topology (the
         #: engine) — None keeps per-replica metrics rows and span attrs
@@ -233,6 +284,14 @@ class Replica:
         #: wall seconds of the last executed batch (compute + D2H), read
         #: by the fleet scheduler to learn its service-time estimate
         self.last_exec_seconds: Optional[float] = None
+        #: circuit breaker: this many CONSECUTIVE failed batches raise
+        #: :class:`ReplicaQuarantined` out of the loop (0 = disabled —
+        #: the single-worker engine, which has no supervisor to catch it)
+        self.quarantine_after = int(quarantine_after)
+        self.consecutive_failures = 0
+        #: the batch currently executing, for the fleet's shutdown path
+        #: to requeue/fail if this worker wedges (None between batches)
+        self.current_batch: Optional[list] = None
 
     @property
     def compiled(self) -> Callable:
@@ -255,35 +314,86 @@ class Replica:
         """Run batches from ``source`` until it returns :data:`STOP`.
         ``source.next_batch(replica)`` returns a request list, None (poll
         again), or STOP; ``source.batch_done(batch, replica)`` runs after
-        every batch, exception or not (queue accounting)."""
+        every batch, exception or not (queue accounting).
+
+        Failure discipline: a TRANSIENT batch failure (injected chaos
+        fault, flaky I/O) requeues its unanswered requests through
+        ``source.requeue_batch`` when the source offers it (the fleet
+        scheduler does; the single-worker engine fails them — it has no
+        peers to retry on). Any other ``Exception`` hits the backstop as
+        before. A ``BaseException`` — an injected :class:`ReplicaKilled`,
+        the quarantine circuit breaker, interpreter teardown — ESCAPES
+        with the unanswered requests attached as ``pending``, exactly so
+        the fleet supervisor can requeue them and restart the worker."""
         while True:
             batch = source.next_batch(self)
             if batch is STOP:
                 return
             if batch:
+                self.current_batch = batch
                 try:
                     self.run_batch(batch)
-                except BaseException:  # run_batch isolates; the backstop
+                except _TransientBatchFault as e:
+                    self._requeue_or_fail(e, source)
+                except Exception:  # run_batch isolates; the backstop
                     logger.exception(
                         "serving replica %s: unexpected batch failure",
                         self.index,
                     )
+                    self.consecutive_failures += 1
                     for r in batch:
                         if not r.future.done():
-                            try:
-                                r.future.set_exception(
-                                    EngineStopped("internal batch failure")
-                                )
-                            except Exception:
-                                pass
+                            settle_future(
+                                r.future,
+                                EngineStopped("internal batch failure"),
+                            )
+                except BaseException as e:
+                    if getattr(e, "pending", None) is None:
+                        try:
+                            e.pending = [
+                                r for r in batch if not r.future.done()
+                            ]
+                        except Exception:
+                            pass
+                    raise
                 finally:
+                    self.current_batch = None
                     source.batch_done(batch, self)
+                self._maybe_quarantine()
             try:
                 # user-registered gauges run inside snapshot(); an
                 # exception there must not kill a worker thread
                 self._metrics.maybe_log(self._log_interval)
             except Exception:
                 logger.exception("serving replica: metrics logging failed")
+
+    def _requeue_or_fail(self, fault: _TransientBatchFault, source) -> None:
+        """Route a transient batch failure's unanswered requests back to
+        the fleet (deadlines intact) — or fail them when the source has
+        no requeue surface (the engine)."""
+        pending = [r for r in fault.pending if not r.future.done()]
+        requeue = getattr(source, "requeue_batch", None)
+        if requeue is not None and pending:
+            n = requeue(pending, self, fault.cause)
+            logger.warning(
+                "serving replica %s: transient batch failure (%s) — "
+                "requeued %d of %d request(s) to peers",
+                self.index, fault.cause, n, len(pending),
+            )
+            return
+        self._metrics.inc("batch_errors")
+        for r in pending:
+            settle_future(r.future, fault.cause)
+
+    def _maybe_quarantine(self) -> None:
+        if (
+            self.quarantine_after
+            and self.consecutive_failures >= self.quarantine_after
+        ):
+            raise ReplicaQuarantined(
+                f"replica {self.index} circuit-broken after "
+                f"{self.consecutive_failures} consecutive batch failures"
+            )
 
     # -- batch execution ------------------------------------------------
 
@@ -300,6 +410,19 @@ class Replica:
         # invalid, execution error) must not leave the PREVIOUS batch's
         # duration for the scheduler to re-fold into its service EWMA
         self.last_exec_seconds = None
+        try:
+            # the chaos seam: kill-kind faults escape as ReplicaDown
+            # (thread death), transient-kind become a requeueable batch
+            # fault — BEFORE any future is marked running
+            fault_point(REPLICA_BATCH, replica=self.index)
+        except ReplicaDown:
+            raise
+        except Exception as e:
+            if is_transient(e):
+                self._metrics.inc("batch_transient")
+                self.consecutive_failures += 1
+                raise _TransientBatchFault(e, list(batch)) from e
+            raise
         now = time.monotonic()
         live = []
         for r in batch:
@@ -364,17 +487,32 @@ class Replica:
                     sp.sync_on(out)
             out = jax.device_get(out)  # one D2H fetch for the whole batch
         except Exception as e:  # batch-level failure → every member errors
+            self.consecutive_failures += 1
+            if is_transient(e):
+                # transient (injected / flaky I/O): a retry on a peer is
+                # expected to succeed — hand the batch back instead of
+                # failing every member
+                self._metrics.inc("batch_transient")
+                raise _TransientBatchFault(e, valid) from e
             self._metrics.inc("batch_errors")
             for r in valid:
                 r.future.set_exception(e)
             return 0
         self.last_exec_seconds = time.perf_counter() - t0
+        self.consecutive_failures = 0
 
         done = time.monotonic()
         for i, r in enumerate(valid):
-            r.future.set_result(
-                jax.tree_util.tree_map(lambda a: a[i], out)
-            )
+            try:
+                r.future.set_result(
+                    jax.tree_util.tree_map(lambda a: a[i], out)
+                )
+            except Exception:
+                # already settled — a bounded shutdown failed this wedged
+                # batch typed while it was still executing; the late real
+                # result loses the set-once race, and the REST of the
+                # batch must still distribute
+                continue
             self._metrics.observe_latency(done - r.enqueued)
         self._metrics.inc("completed", len(valid))
         self._metrics.observe_batch(len(valid), bucket, replica=self.index)

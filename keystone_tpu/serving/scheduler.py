@@ -51,7 +51,32 @@ from ..obs.tracer import current as _trace_current
 from .batching import BucketPolicy
 from .errors import EngineStopped, QueueFull, Shed
 from .metrics import MetricsRegistry
-from .replica import STOP, _Request
+from .replica import STOP, _Request, settle_future
+
+
+def _chain_futures(clone, orig) -> None:
+    """Forward a requeued clone's outcome to the original future. The
+    original may already be marked RUNNING (it was popped into the batch
+    the dead replica never finished), so it cannot simply re-enter a
+    queue — a fresh request carries the datum, and the answer flows back
+    here."""
+
+    def _copy(done):
+        if orig.done():
+            return
+        try:
+            if done.cancelled():
+                orig.cancel()
+                return
+            exc = done.exception()
+            if exc is not None:
+                orig.set_exception(exc)
+            else:
+                orig.set_result(done.result())
+        except Exception:
+            pass  # lost a race with another settler
+
+    clone.add_done_callback(_copy)
 
 logger = logging.getLogger(__name__)
 
@@ -87,6 +112,9 @@ class FleetScheduler:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: List[deque] = [deque() for _ in range(n_replicas)]
+        #: replica liveness, maintained by the fleet's supervisor: a dead
+        #: (restart-budget-exhausted) replica stops receiving admissions
+        self._active: List[bool] = [True] * n_replicas
         self._depth = 0  # total queued across all replica queues
         self._in_flight = 0  # batches handed to replicas, not yet done
         self._closed = False  # no further admission
@@ -156,9 +184,15 @@ class FleetScheduler:
                         f"{est:.4f}s exceeds the request's "
                         f"{max(req.deadline - time.monotonic(), 0):.4f}s budget"
                     )
-            # shallowest queue: depth-balanced placement; drain-rate
+            # shallowest LIVE queue: depth-balanced placement; drain-rate
             # imbalance is work-stealing's job, not admission's
-            target = min(range(self._n), key=lambda i: len(self._queues[i]))
+            live = [i for i in range(self._n) if self._active[i]]
+            if not live:
+                raise EngineStopped(
+                    "no live replicas (every worker is down and the "
+                    "restart budget is exhausted)"
+                )
+            target = min(live, key=lambda i: len(self._queues[i]))
             self._queues[target].append(req)
             self._depth += 1
             # counted here, under the lock, so a snapshot can never
@@ -266,6 +300,142 @@ class FleetScheduler:
         self._queues[index].extend(reversed(moved))
         self._metrics.inc("steals", take)
         return take
+
+    # -- replica supervision (fleet failure recovery) --------------------
+
+    def set_active(self, index: int, active: bool) -> None:
+        """Mark one replica live/dead for admission placement (the fleet
+        supervisor flips this around deaths and restarts)."""
+        with self._cond:
+            self._active[index] = bool(active)
+            self._cond.notify_all()
+
+    def any_active(self) -> bool:
+        with self._cond:
+            return any(self._active)
+
+    def _shed_requeued(self, req: _Request, est: float, now: float) -> None:
+        self._metrics.inc("shed")
+        settle_future(
+            req.future,
+            Shed(
+                f"deadline unmeetable after replica failure: estimated "
+                f"wait {est:.4f}s exceeds the request's remaining "
+                f"{max(req.deadline - now, 0):.4f}s budget"
+            ),
+        )
+
+    def requeue_replica(self, index: int, keep_if_no_peer: bool = False) -> int:
+        """Move a down replica's QUEUED requests to live peers, deadlines
+        intact. A request whose deadline the learned estimate says can no
+        longer be met is answered with a typed :class:`Shed` here, not
+        left to expire silently replica-side. With no live peer:
+        ``keep_if_no_peer`` leaves the queue in place (the replica is
+        about to restart), else the requests fail typed. Returns the
+        count moved."""
+        with self._cond:
+            q = self._queues[index]
+            if not q:
+                return 0
+            reqs = list(q)
+            q.clear()
+            now = time.monotonic()
+            est = self.estimated_wait()
+            peers = [
+                i for i in range(self._n) if self._active[i] and i != index
+            ]
+            moved = 0
+            for req in reqs:
+                if req.future.done():
+                    self._depth -= 1
+                    continue
+                if req.deadline is not None and now + est > req.deadline:
+                    self._depth -= 1
+                    self._shed_requeued(req, est, now)
+                    continue
+                if peers:
+                    target = min(peers, key=lambda i: len(self._queues[i]))
+                    self._queues[target].append(req)
+                    moved += 1
+                elif keep_if_no_peer:
+                    q.append(req)
+                else:
+                    self._depth -= 1
+                    settle_future(
+                        req.future,
+                        EngineStopped(
+                            "no live replicas to take over this request"
+                        ),
+                    )
+            if moved:
+                self._metrics.inc("requeues", moved)
+            self._cond.notify_all()
+        return moved
+
+    #: a request rerouted off this many failed replicas stops bouncing
+    #: and is answered with the failure instead — the bound that keeps a
+    #: deadline-less request from livelocking across a recurring fault
+    MAX_REQUEUE_HOPS = 3
+
+    def requeue_batch(self, requests, replica, cause=None) -> int:
+        """Re-admit a dead/faulted replica's IN-FLIGHT requests. Their
+        futures may already be marked running, so each request re-enters
+        as a fresh clone whose outcome chains back to the original;
+        deadlines and enqueue times carry over unchanged (satellite
+        contract: rerouting never extends a deadline). Unmeetable
+        deadlines get the typed :class:`Shed`; a request already
+        rerouted :data:`MAX_REQUEUE_HOPS` times is answered with
+        ``cause`` (the failure that keeps chasing it) instead of
+        bouncing forever; the rest land at the FRONT of the shallowest
+        live peer queue (they are the oldest work in the system).
+        Returns the count requeued."""
+        index = getattr(replica, "index", None)
+        fail_exc = (
+            cause if isinstance(cause, Exception)
+            else EngineStopped("request lost its replica repeatedly")
+        )
+        with self._cond:
+            now = time.monotonic()
+            est = self.estimated_wait()
+            peers = [
+                i for i in range(self._n) if self._active[i] and i != index
+            ]
+            moved = 0
+            # appendleft reverses, so walk the batch back-to-front to
+            # keep the original FIFO order at the head of the queue
+            for req in reversed(list(requests)):
+                if req.future.done():
+                    continue
+                if req.deadline is not None and now + est > req.deadline:
+                    self._shed_requeued(req, est, now)
+                    continue
+                if req.hops >= self.MAX_REQUEUE_HOPS:
+                    settle_future(req.future, fail_exc)
+                    continue
+                if peers:
+                    target = min(peers, key=lambda i: len(self._queues[i]))
+                elif index is not None and self._active[index]:
+                    target = index  # restarting in place: retry locally
+                else:
+                    settle_future(
+                        req.future,
+                        EngineStopped(
+                            "no live replicas to take over this request"
+                        ),
+                    )
+                    continue
+                clone = _Request(
+                    datum=req.datum, deadline=req.deadline,
+                    enqueued=req.enqueued, hops=req.hops + 1,
+                )
+                _chain_futures(clone.future, req.future)
+                self._queues[target].appendleft(clone)
+                self._depth += 1
+                moved += 1
+            if moved:
+                self._metrics.inc("requeues", moved)
+            self._cond.notify_all()
+        return moved
 
     # -- lifecycle -------------------------------------------------------
 

@@ -358,6 +358,23 @@ class GridSweep:
             fit_grid = type(ests[0]).fit_lambda_grid
             import inspect
 
+            # a member fitted with checkpoint=dir keeps its resume
+            # contract through the grouped accumulation (the family key
+            # includes the dir, so one group = one checkpoint)
+            ckpt = getattr(ests[0], "checkpoint", None)
+            if ckpt is not None:
+                if "checkpoint" in inspect.signature(fit_grid).parameters:
+                    kwargs["checkpoint"] = ckpt
+                    kwargs["checkpoint_every"] = getattr(
+                        ests[0], "checkpoint_every", 1
+                    )
+                else:
+                    logger.warning(
+                        "sweep: %s members requested checkpoint=%r but "
+                        "the family's grouped fit is not resumable — "
+                        "the shared pass runs uncheckpointed",
+                        type(ests[0]).__name__, ckpt,
+                    )
             if "warm_start" in inspect.signature(fit_grid).parameters:
                 kwargs["warm_start"] = self.warm_start
                 from ..data.chunked import ChunkedDataset

@@ -20,10 +20,13 @@ def pipeline_env():
     from keystone_tpu.workflow.env import PipelineEnv
 
     import keystone_tpu.cost as cost
+    import keystone_tpu.faults as faults
 
     env = PipelineEnv.get_or_create()
     env.reset()
     cost.reset()  # profile store is env-var-memoized like the AOT cache
+    faults.clear()  # no fault plan (or stale invocation counters) leaks
     yield env
     env.reset()
     cost.reset()
+    faults.clear()

@@ -1,0 +1,37 @@
+"""The aot.read fault point: a transient cache-read fault degrades to a
+miss (the caller traces live), a fatal one propagates, and with no plan
+the read path is untouched."""
+
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.compile.cache import ExecutableCache
+
+ENV = {"jax": "x"}
+
+
+def _store(cache, key="k1"):
+    return cache.store(key, b"payload-bytes", {"env": dict(ENV)})
+
+
+def test_transient_read_fault_degrades_to_miss_then_recovers(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    _store(cache)
+    faults.install(faults.parse_plan("aot.read=transient@0"))
+    assert cache.load("k1", expect_env=ENV) is None  # injected: a miss
+    entry = cache.load("k1", expect_env=ENV)  # next read is fine
+    assert entry is not None and entry.payload == b"payload-bytes"
+
+
+def test_fatal_read_fault_propagates(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    _store(cache)
+    faults.install(faults.parse_plan("aot.read=fatal@0"))
+    with pytest.raises(faults.FatalFaultInjected):
+        cache.load("k1", expect_env=ENV)
+
+
+def test_no_plan_reads_normally(tmp_path):
+    cache = ExecutableCache(str(tmp_path))
+    _store(cache)
+    assert cache.load("k1", expect_env=ENV) is not None

@@ -1,0 +1,233 @@
+"""Resumable fits: FitCheckpoint store integrity + kill-and-resume
+parity (bit-identical solver state, only-remaining-chunks work gate)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.faults import FatalFaultInjected, FitCheckpoint
+from keystone_tpu.linalg.accumulators import (
+    GramSolverState,
+    MomentsState,
+    TsqrRState,
+)
+from keystone_tpu.nodes.learning.linear import (
+    LinearMapEstimator,
+    TSQRLeastSquaresEstimator,
+)
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+
+def test_round_trip_preserves_every_accumulator_bit_for_bit(tmp_path):
+    rng = np.random.RandomState(0)
+    gram = GramSolverState()
+    gram.update(rng.randn(8, 4).astype(np.float32),
+                rng.randn(8, 2).astype(np.float32))
+    tsqr = TsqrRState()
+    tsqr.update(rng.randn(8, 4).astype(np.float32))
+    mom = MomentsState()
+    mom.update(rng.randn(8, 4))
+
+    ck = FitCheckpoint(str(tmp_path), "k1")
+    ck.save({"gram": gram, "tsqr": tsqr, "mom": mom}, 3, 24)
+    state, chunk, rows = ck.load()
+    assert (chunk, rows) == (3, 24)
+    assert np.array_equal(state["gram"].gram, gram.gram)
+    assert np.array_equal(state["gram"].cross, gram.cross)
+    assert np.array_equal(state["tsqr"].r, tsqr.r)
+    assert np.array_equal(state["mom"].m2, mom.m2)
+
+
+def test_missing_corrupt_and_truncated_degrade_to_fresh(tmp_path):
+    ck = FitCheckpoint(str(tmp_path), "k")
+    assert ck.load() is None  # missing
+    ck.save(MomentsState(), 1, 8)
+    with open(ck.path, "r+b") as f:
+        f.seek(30)
+        f.write(b"\xff\xff\xff")
+    assert ck.load() is None  # corrupt: checksum fails
+    assert not os.path.exists(ck.path)  # and the entry was deleted
+    ck.save(MomentsState(), 1, 8)
+    blob = open(ck.path, "rb").read()
+    with open(ck.path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert ck.load() is None  # truncated
+
+
+def test_foreign_key_is_ignored_not_resumed(tmp_path):
+    a = FitCheckpoint(str(tmp_path), "fit-a")
+    a.save(MomentsState(), 2, 16)
+    # same file, different key (simulates a hash collision / misuse)
+    b = FitCheckpoint(str(tmp_path), "fit-b")
+    b.path = a.path
+    assert b.load() is None
+    assert os.path.exists(a.path)  # foreign entries are kept, not deleted
+
+
+def test_save_is_atomic_no_tmp_left_and_complete_removes(tmp_path):
+    ck = FitCheckpoint(str(tmp_path), "k")
+    for i in range(4):
+        ck.save(MomentsState(), i, i * 8)
+    leftovers = [f for f in os.listdir(tmp_path) if f.startswith(".tmp")]
+    assert leftovers == []
+    assert ck.exists()
+    ck.complete()
+    assert not ck.exists()
+    ck.complete()  # idempotent
+
+
+def test_unpicklable_header_is_a_miss(tmp_path):
+    ck = FitCheckpoint(str(tmp_path), "k")
+    import hashlib
+
+    payload = b"not a pickle"
+    blob = b"KSFITCKPT1\n" + hashlib.sha256(payload).digest() + payload
+    with open(ck.path, "wb") as f:
+        f.write(blob)
+    assert ck.load() is None
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume fits
+# ---------------------------------------------------------------------------
+
+
+def _fit_problem(n=96, d=12, k=3, chunk_rows=16, label="ckfit"):
+    rng = np.random.RandomState(4)
+    X = rng.randn(n, d).astype(np.float32)
+    Y = rng.randn(n, k).astype(np.float32)
+    chunks = [X[i : i + chunk_rows] for i in range(0, n, chunk_rows)]
+    produced = []
+
+    def chunk_fn(i):
+        produced.append(i)
+        return chunks[i]
+
+    ds = ChunkedDataset.from_chunk_fn(chunk_fn, len(chunks), n, label=label)
+    return ds, Dataset(Y, batched=True), produced
+
+
+def test_killed_gram_fit_resumes_bit_identical_and_skips_folded(tmp_path):
+    ds, labels, produced = _fit_problem()
+    ref = LinearMapEstimator(lam=0.5, snapshot=True).fit(ds, labels)
+
+    produced.clear()
+    faults.install(faults.parse_plan("scan.chunk=fatal@3"))
+    with pytest.raises(FatalFaultInjected):
+        LinearMapEstimator(
+            lam=0.5, snapshot=True, checkpoint=str(tmp_path)
+        ).fit(ds, labels)
+    assert sorted(set(produced)) == [0, 1, 2]
+    faults.clear()
+
+    produced.clear()
+    resumed = LinearMapEstimator(
+        lam=0.5, snapshot=True, checkpoint=str(tmp_path)
+    ).fit(ds, labels)
+    # the work gate: resume produced ONLY the unfolded chunks
+    assert sorted(set(produced)) == [3, 4, 5]
+    # bit-for-bit state parity with the uninterrupted fit
+    for attr in ("gram", "cross", "sum_x", "sum_y", "shift", "shift_y"):
+        assert np.array_equal(
+            getattr(ref.solver_state, attr), getattr(resumed.solver_state, attr)
+        ), attr
+    assert ref.solver_state.n == resumed.solver_state.n
+    assert np.array_equal(np.asarray(ref.W), np.asarray(resumed.W))
+    # the finished fit removed its checkpoint
+    assert os.listdir(tmp_path) == []
+
+
+def test_killed_tsqr_fit_resumes_without_refolding(tmp_path):
+    ds, labels, produced = _fit_problem(label="cktsqr")
+    ref = TSQRLeastSquaresEstimator(
+        lam=0.5, checkpoint=str(tmp_path / "ref")
+    ).fit(ds, labels)
+
+    produced.clear()
+    faults.install(faults.parse_plan("scan.chunk=fatal@10"))  # during fold
+    with pytest.raises(FatalFaultInjected):
+        TSQRLeastSquaresEstimator(
+            lam=0.5, checkpoint=str(tmp_path / "kill")
+        ).fit(ds, labels)
+    faults.clear()
+    killed_at = sorted(set(produced))
+
+    produced.clear()
+    resumed = TSQRLeastSquaresEstimator(
+        lam=0.5, checkpoint=str(tmp_path / "kill")
+    ).fit(ds, labels)
+    # the means pass was checkpointed too: resume re-produced strictly
+    # fewer chunks than the killed run's two passes
+    assert len(set(produced)) < len(killed_at) + 6
+    assert np.array_equal(np.asarray(ref.W), np.asarray(resumed.W))
+    assert np.array_equal(
+        np.asarray(ref.feature_mean), np.asarray(resumed.feature_mean)
+    )
+
+
+def test_tsqr_checkpoint_path_matches_laned_path():
+    ds, labels, _ = _fit_problem(label="cmp")
+    laned = TSQRLeastSquaresEstimator(lam=0.25).fit(ds, labels)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ck = TSQRLeastSquaresEstimator(lam=0.25, checkpoint=tmp).fit(
+            ds, labels
+        )
+    diff = float(np.max(np.abs(np.asarray(laned.W) - np.asarray(ck.W))))
+    assert diff <= 1e-5, diff
+
+
+def test_sweep_grouped_fit_keeps_the_checkpoint_contract(tmp_path):
+    """A checkpointed estimator fitted THROUGH a GridSweep's shared
+    accumulation pass stays resumable: the sweep forwards checkpoint
+    args to the family's grouped fit, and a killed sweep re-run resumes
+    from the cursor instead of rescanning."""
+    from keystone_tpu.sweep import GridSweep
+    from keystone_tpu.workflow.transformer import FunctionNode
+
+    ds, labels, produced = _fit_problem(label="sweepck")
+    prefix = FunctionNode(batch_fn=lambda x: x, label="ident").to_pipeline()
+
+    def sweep():
+        return GridSweep(
+            prefix,
+            lambda lam: LinearMapEstimator(lam=lam, checkpoint=str(tmp_path)),
+            {"lam": [0.1, 1.0]},
+            ds, labels,
+        ).fit()
+
+    faults.install(faults.parse_plan("scan.chunk=fatal@3"))
+    with pytest.raises(faults.FatalFaultInjected):
+        sweep()
+    faults.clear()
+    produced.clear()
+    res = sweep()
+    assert len(res) == 2
+    assert sorted(set(produced)) == [3, 4, 5]  # resumed, not rescanned
+
+
+def test_checkpoint_key_change_starts_fresh(tmp_path):
+    """A different λ grid is a different fit: its checkpoint must not be
+    resumed (the key binds solver family, shapes, and λ)."""
+    ds, labels, produced = _fit_problem(label="ckkey")
+    faults.install(faults.parse_plan("scan.chunk=fatal@3"))
+    with pytest.raises(FatalFaultInjected):
+        LinearMapEstimator(
+            lam=0.5, snapshot=True, checkpoint=str(tmp_path)
+        ).fit(ds, labels)
+    faults.clear()
+    produced.clear()
+    # different lam -> different key -> full fresh pass
+    LinearMapEstimator(
+        lam=2.0, snapshot=True, checkpoint=str(tmp_path)
+    ).fit(ds, labels)
+    assert sorted(set(produced)) == [0, 1, 2, 3, 4, 5]

@@ -1,0 +1,128 @@
+"""FaultPlan grammar, determinism, and the fault_point hook."""
+
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.faults import (
+    FatalFaultInjected,
+    FaultInjected,
+    ReplicaKilled,
+    TransientError,
+    fault_point,
+    parse_plan,
+)
+
+
+def test_parse_index_clause_defaults_to_first_invocation():
+    plan = parse_plan("scan.chunk=transient")
+    spec = plan._by_site["scan.chunk"][0]
+    assert spec.kind == "transient"
+    assert spec.at == frozenset((0,))
+
+
+def test_parse_multi_clause_with_indices_match_and_probabilistic():
+    plan = parse_plan(
+        "scan.chunk=transient@2,5;replica.batch#1=kill@3;"
+        "scan.stage=fatal@p0.25x2s7"
+    )
+    assert set(plan.sites) == {"scan.chunk", "replica.batch", "scan.stage"}
+    chunk = plan._by_site["scan.chunk"][0]
+    assert chunk.at == frozenset((2, 5))
+    rb = plan._by_site["replica.batch"][0]
+    assert rb.kind == "kill" and rb.match == 1 and rb.at == frozenset((3,))
+    st = plan._by_site["scan.stage"][0]
+    assert st.at is None
+    assert (st.rate, st.limit, st.seed) == (0.25, 2, 7)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "scan.chunk",                 # no '='
+        "scan.chunk=explode",         # unknown kind
+        "scan.chunk=transient@p1.5",  # rate out of range
+        "scan.chunk=transient@x,y",   # non-integer indices
+        "",                           # empty plan
+        "scan.chunk#a=kill",          # non-integer match
+    ],
+)
+def test_parse_rejects_bad_clauses_loudly(bad):
+    with pytest.raises(ValueError):
+        parse_plan(bad)
+
+
+def test_index_clause_fires_exactly_at_its_invocations():
+    plan = parse_plan("s=transient@1,3")
+    hits = [plan.check("s", {}) for _ in range(6)]
+    assert hits == [None, "transient", None, "transient", None, None]
+    assert plan.injected["s"] == 2
+
+
+def test_probabilistic_clause_is_seeded_and_bounded():
+    runs = []
+    for _ in range(2):
+        plan = parse_plan("s=transient@p0.5x3s42")
+        runs.append([plan.check("s", {}) is not None for _ in range(40)])
+    assert runs[0] == runs[1]  # same seed => identical schedule
+    assert sum(runs[0]) == 3  # the x3 bound
+    plan = parse_plan("s=transient@p0.5x3s43")
+    assert [plan.check("s", {}) is not None for _ in range(40)] != runs[0]
+
+
+def test_match_clause_counts_only_matching_invocations():
+    plan = parse_plan("replica.batch#0=transient@1")
+    # replica 1's invocations do not advance replica 0's clause counter
+    assert plan.check("replica.batch", {"replica": 1}) is None
+    assert plan.check("replica.batch", {"replica": 0}) is None  # index 0
+    assert plan.check("replica.batch", {"replica": 1}) is None
+    assert plan.check("replica.batch", {"replica": 0}) == "transient"
+
+
+def test_reset_replays_the_identical_schedule():
+    plan = parse_plan("s=transient@p0.4x5s9")
+    first = [plan.check("s", {}) for _ in range(30)]
+    plan.reset()
+    assert [plan.check("s", {}) for _ in range(30)] == first
+
+
+def test_fault_point_raises_typed_errors_and_noop_without_plan():
+    # no plan installed (conftest cleared): a pure no-op
+    fault_point("scan.chunk")
+
+    faults.install(parse_plan("a=transient@0;b=fatal@0;c=kill@0"))
+    with pytest.raises(FaultInjected) as ei:
+        fault_point("a")
+    assert isinstance(ei.value, TransientError)
+    with pytest.raises(FatalFaultInjected):
+        fault_point("b")
+    assert not faults.is_transient(FatalFaultInjected("b", 0))
+    with pytest.raises(ReplicaKilled) as ki:
+        fault_point("c")
+    # kill must bypass `except Exception` backstops
+    assert not isinstance(ki.value, Exception)
+    faults.clear()
+    fault_point("a")  # cleared: no-op again
+
+
+def test_env_plan_is_cached_on_raw_string(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FAULTS", "x=transient@0")
+    p1 = faults.active_plan()
+    assert p1 is faults.active_plan()  # same string -> same plan object
+    with pytest.raises(FaultInjected):
+        fault_point("x")
+    fault_point("x")  # invocation 1: already fired, counters persist
+    monkeypatch.setenv("KEYSTONE_FAULTS", "x=transient@1")
+    p2 = faults.active_plan()
+    assert p2 is not p1  # new string -> fresh parse, fresh counters
+    fault_point("x")  # invocation 0 of the new plan: no fault
+    with pytest.raises(FaultInjected):
+        fault_point("x")
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    assert faults.active_plan() is None
+
+
+def test_transient_classification_covers_stdlib_families():
+    assert faults.is_transient(ConnectionResetError())
+    assert faults.is_transient(TimeoutError())
+    assert not faults.is_transient(ValueError())
+    assert not faults.is_transient(ReplicaKilled("k"))

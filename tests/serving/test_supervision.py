@@ -1,0 +1,347 @@
+"""Replica supervision: killed/quarantined workers requeue their work
+(deadlines intact), restart within budget, and never strand a request —
+plus the bounded-shutdown satellite (a wedged replica cannot block
+shutdown forever) and the deadline-under-requeue semantics."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.serving import EngineStopped, ServingFleet, Shed
+from keystone_tpu.serving.batching import BucketPolicy
+from keystone_tpu.serving.metrics import MetricsRegistry
+from keystone_tpu.serving.replica import _Request
+from keystone_tpu.serving.scheduler import FleetScheduler
+from keystone_tpu.workflow.transformer import FunctionNode
+
+
+def _fitted(label="double"):
+    return FunctionNode(
+        batch_fn=lambda X: X * 2.0, label=label
+    ).to_pipeline().fit()
+
+
+def _hammer(fleet, n=48, clients=8, timeout=30.0):
+    with ThreadPoolExecutor(max_workers=clients) as pool:
+        return list(pool.map(
+            lambda i: float(np.asarray(
+                fleet.predict(np.full(3, float(i)), timeout=timeout)
+            ).ravel()[0]),
+            range(n),
+        ))
+
+
+# ---------------------------------------------------------------------------
+# kill / restart
+# ---------------------------------------------------------------------------
+
+
+def test_replica_kill_loses_zero_accepted_requests_and_restarts():
+    """The chaos gate: a mid-load thread kill answers every accepted
+    request correctly; the supervisor requeues and restarts."""
+    faults.install(faults.parse_plan("replica.batch#1=kill@2"))
+    fleet = ServingFleet(
+        _fitted(), replicas=2, buckets=(4, 8), datum_shape=(3,),
+        max_wait_ms=1.0,
+    )
+    with fleet:
+        res = _hammer(fleet, 48)
+    for i, r in enumerate(res):
+        assert abs(r - 2.0 * i) < 1e-4
+    c = fleet.metrics.snapshot()["counters"]
+    assert c["completed"] == c["submitted"] == 48
+    assert c["restarts"] >= 1
+    assert c["requeues"] >= 1
+    assert c.get("batch_errors", 0) == 0
+
+
+def test_kill_and_restart_land_in_the_trace():
+    from keystone_tpu.obs import tracer as obs_tracer
+
+    faults.install(faults.parse_plan("replica.batch=kill@1"))
+    tr = obs_tracer.install(obs_tracer.Tracer())
+    try:
+        fleet = ServingFleet(
+            _fitted(), replicas=2, buckets=(4,), datum_shape=(3,),
+            max_wait_ms=1.0,
+        )
+        with fleet:
+            _hammer(fleet, 24)
+    finally:
+        obs_tracer.uninstall(tr)
+    names = {s.name for s in tr.spans()}
+    assert "fault.inject" in names
+    assert "fault.replica_down" in names
+    assert "fault.replica_restart" in names
+
+
+def test_quarantine_after_consecutive_transient_failures():
+    """K consecutive transient batch failures circuit-break the replica:
+    its batches requeue to the peer, the breaker trips, the supervisor
+    restarts it, and no request is lost."""
+    faults.install(faults.parse_plan("replica.batch#0=transient@0,1,2"))
+    fleet = ServingFleet(
+        _fitted(), replicas=2, buckets=(4,), datum_shape=(3,),
+        max_wait_ms=1.0, quarantine_after=3,
+    )
+    total = 0
+    with fleet:
+        # waves until replica 0 has pulled (and transiently failed) its
+        # three scheduled batches — how the load interleaves across the
+        # two workers is timing-dependent, the fault schedule is not
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            res = _hammer(fleet, 16)
+            assert all(abs(r - 2.0 * i) < 1e-4 for i, r in enumerate(res))
+            total += len(res)
+            c = fleet.metrics.snapshot()["counters"]
+            if c.get("quarantined", 0) >= 1:
+                break
+        else:
+            pytest.fail("replica 0 never tripped its circuit breaker")
+    c = fleet.metrics.snapshot()["counters"]
+    assert c["completed"] == c["submitted"] == total  # nothing lost
+    assert c["quarantined"] == 1
+    assert c["restarts"] >= 1
+    assert c["batch_transient"] == 3
+    assert c["requeues"] >= 3
+
+
+def test_restart_budget_exhaustion_leaves_the_peer_serving():
+    """A replica that keeps dying exhausts its budget and stays down;
+    the survivor serves everything (admission avoids the dead queue)."""
+    faults.install(faults.parse_plan("replica.batch#0=kill@p1.0x9s1"))
+    fleet = ServingFleet(
+        _fitted(), replicas=2, buckets=(4,), datum_shape=(3,),
+        max_wait_ms=1.0, max_restarts=1,
+    )
+    with fleet:
+        res = _hammer(fleet, 32)
+    assert all(abs(r - 2.0 * i) < 1e-4 for i, r in enumerate(res))
+    c = fleet.metrics.snapshot()["counters"]
+    assert c["completed"] == 32
+    assert c["restarts"] == 1  # budget, not the kill count
+
+
+def test_all_replicas_down_fails_typed_never_hangs():
+    faults.install(faults.parse_plan("replica.batch=kill@p1.0x9s1"))
+    fleet = ServingFleet(
+        _fitted(), replicas=1, buckets=(4,), datum_shape=(3,),
+        max_wait_ms=1.0, max_restarts=0,
+    )
+    fleet.start()
+    try:
+        f = fleet.submit(np.zeros(3, np.float32))
+        with pytest.raises(EngineStopped):
+            f.result(timeout=10)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                fleet.submit(np.zeros(3, np.float32))
+            except EngineStopped:
+                break  # admission now refuses typed
+            time.sleep(0.01)
+        else:
+            pytest.fail("admission kept accepting with no live replicas")
+    finally:
+        fleet.shutdown()
+
+
+def test_supervise_off_requeues_but_never_restarts():
+    faults.install(faults.parse_plan("replica.batch#0=kill@0"))
+    fleet = ServingFleet(
+        _fitted(), replicas=2, buckets=(4,), datum_shape=(3,),
+        max_wait_ms=1.0, supervise=False,
+    )
+    with fleet:
+        res = _hammer(fleet, 24)
+    assert all(abs(r - 2.0 * i) < 1e-4 for i, r in enumerate(res))
+    c = fleet.metrics.snapshot()["counters"]
+    assert c["completed"] == 24
+    assert c.get("restarts", 0) == 0  # work moved to the peer instead
+
+
+# ---------------------------------------------------------------------------
+# deadline semantics under requeue (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _sched(n=2, buckets=(4,)):
+    return FleetScheduler(
+        n,
+        BucketPolicy(buckets, datum_shape=(2,)),
+        MetricsRegistry("supervision-test"),
+    )
+
+
+def test_requeued_request_keeps_its_original_deadline():
+    s = _sched()
+    now = time.monotonic()
+    req = _Request(datum="d", deadline=now + 30.0, enqueued=now)
+    req.future.set_running_or_notify_cancel()  # it was mid-batch
+    moved = s.requeue_batch([req], SimpleNamespace(index=0))
+    assert moved == 1
+    depths = s.queue_depths()
+    assert depths == [0, 1]  # rerouted to the peer, at the front
+    clone = s._queues[1][0]
+    assert clone.deadline == req.deadline  # deadline intact, not reset
+    assert clone.enqueued == req.enqueued
+    # the clone's outcome flows back to the original future
+    clone.future.set_running_or_notify_cancel()
+    clone.future.set_result("answer")
+    assert req.future.result(timeout=1) == "answer"
+
+
+def test_unmeetable_requeued_deadline_is_shed_typed_not_expired():
+    s = _sched()
+    s.observe_service(1.0)  # learned service time: 1s per batch
+    now = time.monotonic()
+    doomed = _Request(datum="d", deadline=now + 0.05, enqueued=now)
+    doomed.future.set_running_or_notify_cancel()
+    ok = _Request(datum="d", deadline=now + 30.0, enqueued=now)
+    moved = s.requeue_batch([doomed, ok], SimpleNamespace(index=0))
+    assert moved == 1  # only the meetable one re-entered
+    with pytest.raises(Shed):
+        doomed.future.result(timeout=1)
+    assert s._metrics.count("shed") == 1
+
+
+def test_queued_requeue_sheds_unmeetable_and_moves_the_rest():
+    s = _sched()
+    s.observe_service(1.0)
+    tight = _Request(
+        datum="d", deadline=time.monotonic() + 0.05, enqueued=time.monotonic()
+    )
+    loose = _Request(
+        datum="d", deadline=time.monotonic() + 30.0, enqueued=time.monotonic()
+    )
+    with s._cond:
+        s._queues[0].extend([tight, loose])
+        s._depth = 2
+    s.set_active(0, False)
+    moved = s.requeue_replica(0)
+    assert moved == 1
+    assert s.queue_depths() == [0, 1]
+    with pytest.raises(Shed):
+        tight.future.result(timeout=1)
+    assert s.depth == 1  # the shed request left the depth accounting
+
+
+def test_requeue_hop_cap_answers_with_the_cause_instead_of_bouncing():
+    """A deadline-less request rerouted off MAX_REQUEUE_HOPS failed
+    replicas stops bouncing and is answered with the recurring failure."""
+    s = _sched()
+    req = _Request(
+        datum="d", deadline=None, enqueued=time.monotonic(),
+        hops=FleetScheduler.MAX_REQUEUE_HOPS,
+    )
+    req.future.set_running_or_notify_cancel()
+    moved = s.requeue_batch(
+        [req], SimpleNamespace(index=0), RuntimeError("recurring fault")
+    )
+    assert moved == 0
+    with pytest.raises(RuntimeError, match="recurring fault"):
+        req.future.result(timeout=1)
+
+
+def test_requeue_clone_carries_the_hop_count():
+    s = _sched()
+    req = _Request(datum="d", deadline=None, enqueued=time.monotonic())
+    req.future.set_running_or_notify_cancel()
+    assert s.requeue_batch([req], SimpleNamespace(index=0)) == 1
+    clone = s._queues[1][0]
+    assert clone.hops == 1
+
+
+def test_engine_worker_death_closes_admission_and_shutdown_returns():
+    """The single-worker engine has no supervisor: a dead worker must
+    close admission (no stranded futures, no drain deadlock)."""
+    from keystone_tpu.serving import EngineClosed, ServingEngine
+
+    faults.install(faults.parse_plan("replica.batch=kill@0"))
+    eng = ServingEngine(_fitted(), buckets=(4,), datum_shape=(3,))
+    eng.start()
+    f = eng.submit(np.zeros(3, np.float32))
+    with pytest.raises(EngineClosed):
+        f.result(timeout=10)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            eng.submit(np.zeros(3, np.float32))
+        except EngineClosed:
+            break
+        time.sleep(0.01)
+    else:
+        pytest.fail("admission stayed open after the worker died")
+    t0 = time.monotonic()
+    eng.shutdown(drain=True)  # must not deadlock on queue.join()
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_requeue_with_no_live_peer_fails_typed():
+    s = _sched()
+    s.set_active(0, False)
+    s.set_active(1, False)
+    req = _Request(datum="d", deadline=None, enqueued=time.monotonic())
+    req.future.set_running_or_notify_cancel()
+    moved = s.requeue_batch([req], SimpleNamespace(index=0))
+    assert moved == 0
+    with pytest.raises(EngineStopped):
+        req.future.result(timeout=1)
+
+
+def test_admission_avoids_inactive_replicas():
+    s = _sched()
+    s.set_active(0, False)
+    for _ in range(3):
+        s.admit(_Request(datum="d", deadline=None, enqueued=time.monotonic()))
+    assert s.queue_depths() == [0, 3]
+
+
+# ---------------------------------------------------------------------------
+# bounded shutdown (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_wedged_replica_shutdown_is_bounded():
+    """A replica stuck inside its batch: shutdown drains with a timeout,
+    joins with a timeout, WARNs, and still answers every admitted
+    request typed — never blocks forever."""
+    import jax
+
+    release = threading.Event()
+
+    def _stall(x):
+        release.wait(timeout=30.0)
+        return x
+
+    def body(X):
+        return jax.pure_callback(
+            _stall, jax.ShapeDtypeStruct(X.shape, X.dtype), X
+        )
+
+    fitted = FunctionNode(batch_fn=body, label="wedge").to_pipeline().fit()
+    fleet = ServingFleet(
+        fitted, replicas=1, buckets=(1,), datum_shape=(3,),
+        max_wait_ms=1.0, join_timeout_s=0.5, drain_timeout_s=0.5,
+    )
+    fleet.start(warmup=False)
+    try:
+        wedged = fleet.submit(np.zeros(3, np.float32))
+        time.sleep(0.2)  # let the batch dispatch and wedge
+        queued = fleet.submit(np.zeros(3, np.float32))
+        t0 = time.monotonic()
+        fleet.shutdown(drain=True)
+        assert time.monotonic() - t0 < 10.0  # bounded, not forever
+        with pytest.raises(EngineStopped):
+            queued.result(timeout=1)
+        with pytest.raises(EngineStopped):
+            wedged.result(timeout=1)
+    finally:
+        release.set()
+        time.sleep(0.1)  # let the wedged thread unwind before teardown
