@@ -2429,6 +2429,322 @@ def bench_serve_fleet() -> dict:
     }
 
 
+def bench_router_fleet() -> dict:
+    """Multi-process serving tier (keystone_tpu/cluster/): a front-door
+    ClusterRouter over worker PROCESSES, each running a local fleet on
+    its device subset — the layer that removes the one-GIL ceiling.
+
+    Gates:
+      * throughput_2_gt_1_ok — 2 worker processes beat 1 on the same
+        closed-loop load over the stall-bearing pipeline (the per-batch
+        host stall is what two PROCESSES genuinely overlap on 2 shared
+        vCPUs — same measurement discipline as serve_fleet);
+      * warm_boot_zero_compiles_ok — a second 2-worker boot against the
+        shared AOT cache dir reports ZERO compiles in every worker's
+        ready message (cache + bucket-signature manifest shared over
+        the filesystem; uses the exportable demo pipeline — the stall
+        pipeline's host callback cannot serialize);
+      * overload_shed_ok — at ~3x measured capacity with per-request
+        deadlines, the front door (and worker admission behind it)
+        sheds typed while ACCEPTED p99 stays in budget;
+      * worker_kill_zero_failures_ok — a worker process SIGKILLed
+        mid-load: the router reroutes its in-flight requests, respawns
+        it within the restart budget, and zero admitted requests fail.
+    """
+    import os
+    import signal
+    import tempfile
+    import shutil
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from keystone_tpu.cluster import ClusterRouter
+    from keystone_tpu.serving import Shed
+
+    d = 256
+    # a FAT per-batch host stall: across processes only the stall
+    # overlaps (2 shared vCPUs can't parallelize compute, and the
+    # router hop + pickling cost real python time), so the stall must
+    # dominate per-batch cost for worker count to be the scaling axis
+    stall_s = 0.020
+    p99_budget_s = 0.75
+    buckets = (8,)
+    stall_spec = (
+        "factory", "keystone_tpu.cluster.demo:build_stall_model",
+        {"d": d, "stall_s": stall_s},
+    )
+    rng = np.random.RandomState(7)
+    data = rng.randn(64, d).astype(np.float32)
+
+    def make_router(workers, **kw):
+        kw.setdefault("max_queue", 1024)
+        return ClusterRouter(
+            stall_spec, workers=workers, replicas_per_worker=1,
+            buckets=buckets, datum_shape=(d,), max_wait_ms=2.0,
+            spawn_timeout_s=300, **kw,
+        )
+
+    def closed_loop(workers, n_requests, clients=32):
+        with make_router(workers) as r:
+            # prime OFF the clock: every worker's first batch pays its
+            # bucket trace — boot cost, not steady-state throughput
+            with ThreadPoolExecutor(max_workers=clients) as pool:
+                list(pool.map(
+                    lambda i: r.predict(data[i % len(data)]),
+                    range(4 * workers * buckets[0]),
+                ))
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=clients) as pool:
+                list(pool.map(
+                    lambda i: r.predict(data[i % len(data)]),
+                    range(n_requests),
+                ))
+            wall = time.perf_counter() - t0
+            snap = r.snapshot()
+        return n_requests / wall, snap
+
+    # best-of-2 trials per worker count: one closed-loop measurement on
+    # a 2-vCPU box occasionally catches an OS-scheduling outlier an
+    # order off the trend (observed), and a GATE must not flap on it
+    n_requests = 256
+    thr1 = thr2 = 0.0
+    snap1 = snap2 = None
+    for _ in range(2):
+        t, s = closed_loop(1, n_requests)
+        if t > thr1:
+            thr1, snap1 = t, s
+        t, s = closed_loop(2, n_requests)
+        if t > thr2:
+            thr2, snap2 = t, s
+
+    # -- warm boot: shared AOT cache + manifest across process boots -----
+    cache_dir = tempfile.mkdtemp(prefix="keystone-router-aot-")
+    demo_spec = (
+        "factory", "keystone_tpu.cluster.demo:build_demo_model",
+        {"num_ffts": 1, "block_size": 512, "n_train": 512},
+    )
+    mnist_data = rng.randn(16, 784).astype(np.float32)
+
+    def demo_boot():
+        with ClusterRouter(
+            demo_spec, workers=2, replicas_per_worker=1, buckets=(8,),
+            datum_shape=(784,), aot_cache=cache_dir, spawn_timeout_s=300,
+        ) as r:
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(lambda i: r.predict(mnist_data[i]), range(16)))
+            return [dict(x) for x in r.worker_reports if x]
+
+    try:
+        cold_reports = demo_boot()
+        warm_reports = demo_boot()
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    warm_compiles = sum(r.get("compiles", 0) for r in warm_reports)
+    warm_loads = sum(r.get("aot_loads", 0) for r in warm_reports)
+
+    # -- overload: open-loop at ~3x measured capacity --------------------
+    # a FRESH router: its workers' latency reservoirs must contain only
+    # the overload window (a capacity-probe backlog in the same
+    # reservoirs would pollute the accepted-p99 gate). Capacity comes
+    # from the 2-worker closed-loop measurement above — conservative
+    # (closed-loop underestimates what the fleet absorbs), so 3x it is
+    # a genuine sustained overload.
+    overload = {}
+    capacity_rps = thr2
+    with make_router(2, max_queue=4096) as r:
+        for _ in range(8):  # prime worker estimates (pongs feed the router)
+            r.predict(data[0])
+        # the front door prices sheds from its own learned estimate:
+        # seed it from the measured drain rate (batches of 8)
+        r.observe_service(8.0 / capacity_rps)
+        duration = 3.0
+        deadline_s = 0.25
+        target_rate = 3.0 * capacity_rps
+        # several open-loop submitter threads: one python thread cannot
+        # pickle+send 3x a multi-worker fleet's capacity by itself, and
+        # an overload bench that cannot actually offer the overload
+        # measures nothing
+        n_submitters = 4
+        lock = threading.Lock()
+        futures, counts = [], {"shed": 0, "offered": 0}
+        accepted_lat: list = []  # appended from done-callbacks
+
+        def submitter(k):
+            t0 = time.perf_counter()
+            i = 0
+            share = target_rate / n_submitters
+            while (now := time.perf_counter() - t0) < duration:
+                due = int(now * share)
+                while i < due:
+                    try:
+                        f = r.submit(
+                            data[i % len(data)], timeout=deadline_s
+                        )
+                        t_sub = time.perf_counter()
+                        # settle-time latency, stamped by the callback —
+                        # polling futures in submit order would charge
+                        # early finishers for the poller's position
+                        f.add_done_callback(
+                            lambda fut, t=t_sub: accepted_lat.append(
+                                time.perf_counter() - t
+                            ) if not fut.exception() else None
+                        )
+                        with lock:
+                            futures.append(f)
+                    except Shed:
+                        with lock:
+                            counts["shed"] += 1
+                    except Exception:
+                        pass  # QueueFull counts via the rejected counter
+                    i += 1
+                time.sleep(0.002)
+            with lock:
+                counts["offered"] += i
+
+        subs = [
+            threading.Thread(target=submitter, args=(k,))
+            for k in range(n_submitters)
+        ]
+        for t in subs:
+            t.start()
+        for t in subs:
+            t.join()
+        failed = late_shed = expired = 0
+        from keystone_tpu.serving import DeadlineExceeded
+
+        for f in futures:
+            try:
+                f.result(timeout=120)
+            except Shed:
+                late_shed += 1
+            except DeadlineExceeded:
+                expired += 1
+            except Exception:
+                failed += 1
+        worker_snaps = r.worker_snapshots()
+        snap_over = r.snapshot()
+    # the GATED accepted-p99 is WORKER-measured (admission → completion
+    # inside the serving tier, merged across workers from their raw
+    # sketches): that is the latency the deadline discipline bounds.
+    # The client-side view (done-callback stamps) is reported alongside
+    # — on 2 shared vCPUs it also measures this bench process's own
+    # submitter-thread scheduling noise, which is not the tier's doing.
+    from keystone_tpu.serving import MetricsRegistry as _MR
+
+    lat_over = _MR.merge(worker_snaps)["latency"]
+    client_p99 = _MR._quantiles(sorted(accepted_lat)).get("p99", 0.0)
+    c_over = snap_over["counters"]
+    shed = counts["shed"]
+    offered = counts["offered"]
+    total_shed = shed + late_shed
+    overload = {
+        "capacity_rps": round(capacity_rps, 1),
+        "offered_rps": round(target_rate, 1),
+        "offered": offered,
+        "accepted": len(futures) - late_shed,
+        "shed_front_door": shed,
+        "shed_worker_side": late_shed,
+        "expired_at_worker": expired,
+        "rejected_queue_full": c_over.get("rejected", 0),
+        "failed_other": failed,
+        "accepted_p99_s": round(lat_over.get("p99", 0.0), 4),
+        "accepted_p99_client_side_s": round(client_p99, 4),
+        "shed_rate": round(total_shed / max(offered, 1), 3),
+    }
+
+    # -- worker kill mid-load: reroute + respawn, zero failures ----------
+    with make_router(2) as r:
+        stop = [False]
+        failures = [0]
+        served = [0]
+
+        def hammer():
+            while not stop[0]:
+                try:
+                    r.predict(data[served[0] % len(data)])
+                    served[0] += 1
+                except Exception:
+                    failures[0] += 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        victim = r.worker_pids[0]
+        os.kill(victim, signal.SIGKILL)
+        time.sleep(1.5)
+        stop[0] = True
+        for t in threads:
+            t.join()
+        # the respawned worker pays a fresh interpreter + jax import +
+        # model rebuild before it rejoins — wait for it off the clock
+        deadline = time.monotonic() + 120
+        while r.live_workers < 2 and time.monotonic() < deadline:
+            time.sleep(0.25)
+        kill_snap = r.snapshot()
+        respawned = r.live_workers
+    c_kill = kill_snap["counters"]
+    kill = {
+        "served_around_kill": served[0],
+        "failures": failures[0],
+        "requeues": c_kill.get("requeues", 0),
+        "restarts": c_kill.get("restarts", 0),
+        "live_workers_after": respawned,
+    }
+
+    p99_1 = snap1["latency"].get("p99", float("inf"))
+    p99_2 = snap2["latency"].get("p99", float("inf"))
+    return {
+        "pipeline": f"host-stall({stall_s * 1e3:.0f}ms) + tanh({d}x16 matmul)",
+        "buckets": list(buckets),
+        "closed_loop_requests": n_requests,
+        "workers_1": {
+            "throughput_rps": round(thr1, 1),
+            "p99_s": round(p99_1, 4),
+        },
+        "workers_2": {
+            "throughput_rps": round(thr2, 1),
+            "p99_s": round(p99_2, 4),
+            "occupancy": snap2["batch_occupancy"]["ratio"],
+        },
+        "speedup_2_vs_1": round(thr2 / max(thr1, 1e-9), 2),
+        "warm_boot": {
+            "cold": [
+                {k: x.get(k, 0) for k in ("compiles", "aot_loads")}
+                for x in cold_reports
+            ],
+            "warm": [
+                {k: x.get(k, 0) for k in ("compiles", "aot_loads")}
+                for x in warm_reports
+            ],
+        },
+        "overload_3x": overload,
+        "worker_kill": kill,
+        "p99_budget_s": p99_budget_s,
+        "throughput_2_gt_1_ok": bool(thr2 > thr1),
+        "warm_boot_zero_compiles_ok": bool(
+            warm_compiles == 0 and warm_loads >= 2
+        ),
+        "overload_shed_ok": bool(
+            total_shed > 0
+            and lat_over.get("p99", float("inf")) <= p99_budget_s
+        ),
+        "worker_kill_zero_failures_ok": bool(
+            failures[0] == 0 and served[0] > 0
+            and c_kill.get("restarts", 0) >= 1 and respawned == 2
+        ),
+        "knobs": (
+            "ClusterRouter(workers=, replicas_per_worker=) / "
+            "KEYSTONE_WORKERS; workers share the AOT cache dir "
+            "(aot_cache=) for zero-compile boots; front door sheds from "
+            "the fleet scheduler's learned service EWMA over aggregate "
+            "depth / capacity"
+        ),
+    }
+
+
 def bench_sharded_scan() -> dict:
     """Mesh-distributed out-of-core scans (data/pipeline_scan.py lanes +
     parallel/lanes.py): weak-scaling rows over virtual device counts
@@ -3186,6 +3502,7 @@ def main() -> int:
     gather_parallel = _section("gather_parallel", bench_gather_parallel)
     serve_cold_start = _section("serve_cold_start", bench_serve_cold_start)
     serve_fleet = _section("serve_fleet", bench_serve_fleet)
+    router_fleet = _section("router_fleet", bench_router_fleet)
     cost_model = _section("cost_model", bench_cost_model)
     mqo_sweep = _section("mqo_sweep", bench_mqo_sweep)
     weak_scaling = _section("weak_scaling", bench_weak_scaling)
@@ -3232,6 +3549,7 @@ def main() -> int:
                     "gather_parallel": gather_parallel,
                     "serve_cold_start": serve_cold_start,
                     "serve_fleet": serve_fleet,
+                    "router_fleet": router_fleet,
                     "cost_model": cost_model,
                     "mqo_sweep": mqo_sweep,
                     "weak_scaling_virtual_mesh": weak_scaling,

@@ -14,6 +14,12 @@
 # Finally a fault-tolerance stage: under an injected mid-demo replica
 # thread kill (KEYSTONE_FAULTS), the supervised fleet must answer every
 # request (zero failures) and record restarts >= 1.
+# Boot 5 lifts serving to the PROCESS tier: a ClusterRouter over 2
+# worker processes against the same pre-warmed AOT cache — every worker
+# must boot with ZERO compiles (shared cache dir + bucket-signature
+# manifest over the filesystem) and serve >= 1 micro-batch, with every
+# response matching (--expect-zero-compiles + the demo's per-worker
+# batch assertion make either failure fatal).
 # Extra flags pass through to the demo, e.g.:
 #   bin/serve-smoke.sh --requests 128 --buckets 8,32,64
 set -euo pipefail
@@ -82,3 +88,5 @@ print(
     f"requeues={c.get('requeues', 0)}, quarantined={c.get('quarantined', 0)}"
 )
 PY
+echo "== boot 5 (router + 2 worker processes, warm: zero compiles in every worker) =="
+"${run[@]}" --workers 2 --expect-zero-compiles "$@"

@@ -168,7 +168,10 @@ def main(argv: Optional[List[str]] = None) -> int:
              "through the serving engine (see keystone_tpu/serving/); "
              "replaces the pipeline name. --replicas N serves from a "
              "continuous-batching ServingFleet of N workers instead of "
-             "the single-worker engine",
+             "the single-worker engine; --workers N (or KEYSTONE_WORKERS) "
+             "serves from a multi-process ClusterRouter of N worker "
+             "processes sharing the AOT cache for warm boots "
+             "(keystone_tpu/cluster/)",
     )
     p.add_argument(
         "--sweep-demo", action="store_true", dest="sweep_demo",

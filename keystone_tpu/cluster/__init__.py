@@ -1,0 +1,45 @@
+"""The multi-process serving tier: a front-door router over worker
+processes.
+
+The serving package scales to N replica THREADS in one process — one
+GIL, one host. This package is the layer above it, the shape the
+KeystoneML premise (cluster-scale dataflow, PAPERS.md #1) and the
+Spark-perf study's driver-bottleneck findings (PAPERS.md #3) call for:
+
+* :class:`ClusterRouter` — admission control and deadline shedding at
+  the front door (the fleet scheduler's learned batch-service EWMA,
+  priced from aggregate queue depth ÷ fleet-wide capacity),
+  least-outstanding load balancing, worker health checks,
+  crash-respawn supervision within a restart budget, merged fleet-wide
+  metrics, and bounded signal-safe shutdown.
+* :mod:`~keystone_tpu.cluster.worker` — the worker process: owns a
+  subset of the mesh data axis
+  (:func:`~keystone_tpu.parallel.placement.worker_device_indices`),
+  runs a local :class:`~keystone_tpu.serving.ServingFleet` over it, and
+  boots WARM by sharing the AOT executable cache directory and
+  bucket-signature manifest over the filesystem — a worker against a
+  warm cache pays zero traces, reported in its ``ready`` message.
+* :mod:`~keystone_tpu.cluster.wire` — the length-framed socket
+  protocol: per-request deadlines cross the process boundary as
+  remaining budget (never extended by the hop), and the serving layer's
+  typed errors (``Shed``, ``DeadlineExceeded``, ``QueueFull``, …)
+  arrive as the same types on the other side.
+
+Sharded chunk PRODUCTION — the training-side half of the same
+host-bottleneck story — lives with the data layer
+(:mod:`keystone_tpu.data.shards`, ``KEYSTONE_SCAN_SHARDS``).
+
+Knobs: ``--workers N`` on the serve demo / ``KEYSTONE_WORKERS`` size
+the tier; see the README's "Multi-process serving" section for the
+topology and the warm-boot contract.
+"""
+
+from .router import ClusterRouter, default_workers
+from .wire import ConnectionClosed, WorkerError
+
+__all__ = [
+    "ClusterRouter",
+    "ConnectionClosed",
+    "WorkerError",
+    "default_workers",
+]

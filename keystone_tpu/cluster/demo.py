@@ -1,0 +1,66 @@
+"""Module-level model factories for the cluster tier's smoke/bench paths.
+
+Worker processes rebuild their model from a ``"module:callable"``
+factory spec — these are the canonical ones. They MUST be deterministic:
+two processes calling the same factory with the same kwargs get the same
+fitted parameters and therefore the same AOT fingerprint, which is what
+lets every worker warm-boot from executables any one process exported
+(the same trick the cold-start bench plays with two processes).
+"""
+
+from __future__ import annotations
+
+
+def build_demo_model(**kwargs):
+    """The serve-demo pipeline (synthetic MNIST + random-FFT + block
+    least squares + argmax) — fitted only, for worker processes."""
+    from ..serving.demo import build_demo_fitted
+
+    fitted, _ = build_demo_fitted(**kwargs)
+    return fitted
+
+
+def _sleep_stall(x, stall_s):
+    """Module-level on purpose: the batch fn must stay content-
+    fingerprintable for the shared-AOT-cache warm-boot contract, so its
+    closures hold only arrays and floats, never modules."""
+    import time
+
+    time.sleep(float(stall_s))
+    return x
+
+
+def build_stall_model(
+    d: int = 256, k: int = 16, stall_s: float = 0.004, scale: float = 1.0,
+    seed: int = 7,
+):
+    """The bench pipeline: a per-batch host stall (``pure_callback``
+    sleep — the stand-in for feature-fetch / IO work real serving does
+    per batch) in front of a small matmul. On shared vCPUs pure compute
+    cannot parallelize, but stalls overlap perfectly across processes —
+    so a 2-worker-over-1-worker throughput gate measures the process
+    tier's real mechanism, not a fantasy of spare cores. Deterministic
+    in ``seed`` for the shared-AOT-cache warm-boot gate."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..workflow.transformer import FunctionNode
+
+    rng = np.random.RandomState(seed)
+    W = jnp.asarray(rng.randn(d, k).astype(np.float32) / np.sqrt(d))
+
+    def body(X, s=float(scale), stall=float(stall_s)):
+        import functools
+
+        import jax as _jax
+
+        X = _jax.pure_callback(
+            functools.partial(_sleep_stall, stall_s=stall),
+            _jax.ShapeDtypeStruct(X.shape, X.dtype), X,
+        )
+        import jax.numpy as _jnp
+
+        return _jnp.tanh((X * s) @ W)
+
+    return FunctionNode(batch_fn=body, label="stall_matmul").to_pipeline().fit()

@@ -1,0 +1,991 @@
+"""The cluster front door: admission control and load balancing over
+worker PROCESSES.
+
+``ClusterRouter`` lifts the ServingFleet's disciplines one level up the
+topology — the fleet schedules N replica *threads* on one GIL; the
+router schedules N worker *processes*, each running a fleet of its own
+over its slice of the mesh:
+
+* **Admission + deadline shedding at the front door.** The same learned
+  batch-service EWMA the in-process scheduler uses
+  (:class:`~keystone_tpu.serving.scheduler.ServiceEstimate` — one
+  class, two tiers), priced from AGGREGATE queue depth ÷ fleet-wide
+  capacity: a request whose deadline the estimate says cannot be met is
+  refused with the typed :class:`~keystone_tpu.serving.errors.Shed`
+  before it crosses a process boundary. Evidence flows back from worker
+  health pongs (each worker's own learned estimate) and an
+  ``observe_service`` seam for tests/benches to seed. A cold router
+  never sheds.
+* **Load balancing.** Least-outstanding placement over live workers —
+  the process-tier analogue of the scheduler's shallowest-queue
+  placement; drain-rate imbalance self-corrects because a slow worker's
+  outstanding count stays high.
+* **Supervision.** A worker whose socket drops (killed process, crash,
+  wedge) has its in-flight requests REQUEUED to live peers with
+  deadlines intact (unmeetable ones answered with the typed ``Shed``,
+  hop-bounded like the fleet's requeue), and is respawned within a
+  per-slot restart budget — the ``faults/`` restart-budget pattern at
+  process scope. ``restarts``/``requeues`` land in the metrics,
+  ``fault.worker_down``/``fault.worker_restart`` instants in the trace.
+* **Warm boots.** Workers share one AOT cache directory + bucket
+  manifest over the filesystem; every worker's ``ready`` message
+  reports the compiles/aot_loads it paid, surfaced in
+  :attr:`worker_reports` (the bench gate: a warm fleet boots with ZERO
+  compiles in every worker).
+* **Merged observability.** ``snapshot()`` pulls each worker's metrics
+  snapshot (with raw quantile sketches) and folds them through
+  :meth:`MetricsRegistry.merge` — the periodic INFO line reports
+  fleet-wide shed/occupancy/queue-age, not per-process shards.
+* **Bounded, signal-safe shutdown.** ``shutdown`` (and the SIGTERM
+  handler ``install_signal_handlers`` registers) drains with a bounded
+  wait, stops workers with per-process join timeouts, WARNs and
+  force-kills a wedged worker, and answers every admitted request typed
+  — mirroring the fleet's bounded thread shutdown at process scope, so
+  demo and smoke runs never hang.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import secrets
+import signal
+import socket
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..faults import WORKER_SPAWN, fault_point
+from ..obs.tracer import current as _trace_current
+from ..serving.errors import EngineStopped, QueueFull, Shed
+from ..serving.metrics import MetricsRegistry
+from ..serving.scheduler import ServiceEstimate
+from ..serving.replica import settle_future
+from ..utils import env_int as _env_int
+from . import wire as wire_mod
+from .wire import (
+    ConnectionClosed,
+    deadline_to_wire,
+    decode_error,
+    recv_msg,
+    send_msg,
+)
+
+logger = logging.getLogger(__name__)
+
+_SPAWN_TIMEOUT_S = 180.0
+_JOIN_TIMEOUT_S = 10.0
+_DRAIN_TIMEOUT_S = 60.0
+
+
+def default_workers() -> int:
+    """Worker-process count: ``KEYSTONE_WORKERS``, default 2 (the
+    smallest fleet that is actually a fleet)."""
+    return _env_int("KEYSTONE_WORKERS", 2)
+
+
+@dataclass
+class _PendingReq:
+    datum: Any
+    deadline: Optional[float]  # router-clock monotonic, or None
+    enqueued: float
+    future: Future = field(default_factory=Future)
+    hops: int = 0
+
+
+class _WorkerSlot:
+    """Router-side state for one worker process slot (the slot survives
+    respawns; the process and socket are replaced)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.proc = None
+        self.sock: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()
+        self.alive = False
+        self.capacity = 0
+        self.restarts = 0
+        #: a respawn is scheduled/booting: requests may PARK awaiting it
+        #: (set by the down-handler, cleared on ready or failed respawn)
+        self.respawning = False
+        self.outstanding: set = set()
+        self.depth = 0  # worker-reported local queue depth (pongs)
+        self.ready_report: Optional[dict] = None
+        self.last_snapshot: Optional[dict] = None
+        #: stats request/reply matching: a stats reply only lands if it
+        #: echoes the CURRENT sequence — a late reply from a previous
+        #: cycle (wedged worker) can neither satisfy this cycle's wait
+        #: nor masquerade stale counters as fresh
+        self.stats_seq = 0
+        self.stats_event = threading.Event()
+        self.recv_thread: Optional[threading.Thread] = None
+
+
+class ClusterRouter:
+    """Front-door router over worker processes. ``model`` is either a
+    :class:`~keystone_tpu.workflow.pipeline.FittedPipeline` (pickled to
+    the workers) or a ``"module:callable"`` factory string (each worker
+    rebuilds deterministically — the warm-boot-friendly spelling),
+    optionally ``(path, kwargs)``."""
+
+    MAX_REQUEUE_HOPS = 3
+
+    def __init__(
+        self,
+        model: Any,
+        *,
+        workers: Optional[int] = None,
+        replicas_per_worker: Optional[int] = None,
+        buckets: Sequence[int] = (1, 8, 32, 64),
+        datum_shape: Optional[Sequence[int]] = None,
+        dtype: Any = None,
+        max_queue: int = 4096,
+        worker_max_queue: int = 1024,
+        max_wait_ms: float = 2.0,
+        aot_cache: Optional[str] = None,
+        warmup: Optional[bool] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        max_restarts: int = 2,
+        spawn_timeout_s: float = _SPAWN_TIMEOUT_S,
+        join_timeout_s: float = _JOIN_TIMEOUT_S,
+        drain_timeout_s: float = _DRAIN_TIMEOUT_S,
+        health_interval_s: float = 2.0,
+        log_interval_s: float = 10.0,
+        virtual_devices: Optional[int] = None,
+        log_level: Optional[str] = None,
+    ):
+        self._n = workers if workers is not None else default_workers()
+        if self._n < 1:
+            raise ValueError(f"need at least one worker, got {self._n}")
+        self._model_spec = self._resolve_model_spec(model)
+        self._spec = {
+            "model": self._model_spec,
+            "n_workers": self._n,
+            "replicas": replicas_per_worker,
+            "buckets": tuple(buckets),
+            "datum_shape": (
+                tuple(datum_shape) if datum_shape is not None else None
+            ),
+            "dtype": str(dtype) if dtype is not None else None,
+            "max_queue": int(worker_max_queue),
+            "max_wait_ms": float(max_wait_ms),
+            "aot_cache": aot_cache,
+            "warmup": warmup,
+            "virtual_devices": virtual_devices,
+            "log_level": log_level,
+        }
+        self._metrics = metrics or MetricsRegistry(name="cluster-router")
+        self._max_queue = int(max_queue)
+        self._max_restarts = int(max_restarts)
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self._join_timeout_s = float(join_timeout_s)
+        self._drain_timeout_s = float(drain_timeout_s)
+        self._health_interval_s = float(health_interval_s)
+        self._log_interval_s = float(log_interval_s)
+        self._service = ServiceEstimate()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._slots = [_WorkerSlot(i) for i in range(self._n)]
+        self._pending: Dict[int, _PendingReq] = {}
+        self._parked: List[_PendingReq] = []
+        self._req_ids = itertools.count()
+        self._token = secrets.token_hex(16)
+        self._listener: Optional[socket.socket] = None
+        self._port: Optional[int] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._started = False
+        self._closed = False
+        self._prev_sigterm = None
+        self._metrics.set_gauge("queue_depth", lambda: self.outstanding)
+
+    @staticmethod
+    def _resolve_model_spec(model) -> tuple:
+        if isinstance(model, tuple) and model and model[0] in (
+            "factory", "pickle"
+        ):
+            return model
+        if isinstance(model, str):
+            return ("factory", model, {})
+        from ..workflow.pipeline import FittedPipeline
+
+        if isinstance(model, FittedPipeline):
+            import pickle
+
+            try:
+                return ("pickle", pickle.dumps(model, protocol=5))
+            except Exception as e:
+                raise ValueError(
+                    "this FittedPipeline cannot be pickled to worker "
+                    "processes — pass a 'module:callable' factory string "
+                    f"that rebuilds it instead ({e})"
+                ) from e
+        raise TypeError(
+            f"model must be a FittedPipeline, 'module:callable' string, "
+            f"or ('factory'|'pickle', ...) tuple — got {type(model).__name__}"
+        )
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def metrics(self) -> MetricsRegistry:
+        return self._metrics
+
+    @property
+    def n_workers(self) -> int:
+        return self._n
+
+    @property
+    def outstanding(self) -> int:
+        """Requests admitted and not yet answered — the aggregate queue
+        depth the shed pricing divides by fleet capacity."""
+        with self._lock:
+            return len(self._pending) + len(self._parked)
+
+    @property
+    def capacity(self) -> int:
+        """Fleet-wide concurrent batch capacity (live workers only)."""
+        with self._lock:
+            return sum(s.capacity for s in self._slots if s.alive)
+
+    @property
+    def live_workers(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s.alive)
+
+    @property
+    def worker_reports(self) -> List[Optional[dict]]:
+        """Each slot's latest ``ready`` report (compiles/aot_loads paid
+        at boot, replica count, devices) — the warm-boot evidence."""
+        with self._lock:
+            return [
+                dict(s.ready_report) if s.ready_report else None
+                for s in self._slots
+            ]
+
+    @property
+    def worker_pids(self) -> List[Optional[int]]:
+        with self._lock:
+            return [
+                s.proc.pid if s.proc is not None else None
+                for s in self._slots
+            ]
+
+    def observe_service(self, seconds: float) -> None:
+        """Seed/fold one batch-service observation (the test/bench seam,
+        same name as the fleet scheduler's)."""
+        with self._lock:
+            self._service.observe(seconds)
+
+    @property
+    def service_estimate(self) -> Optional[float]:
+        return self._service.estimate
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ClusterRouter":
+        with self._lock:
+            if self._started:
+                raise RuntimeError("router already started")
+            if self._closed:
+                raise EngineStopped("router was shut down")
+            self._started = True
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(self._n + 4)
+        self._listener.settimeout(0.5)
+        self._port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ks-router-accept", daemon=True
+        )
+        self._accept_thread.start()
+        for slot in self._slots:
+            self._spawn_worker(slot)
+        deadline = time.monotonic() + self._spawn_timeout_s
+        with self._cond:
+            while not all(s.alive for s in self._slots):
+                if self._closed:
+                    raise EngineStopped("router shut down during start")
+                dead = [
+                    s.index for s in self._slots
+                    if s.proc is not None and not s.alive
+                    and s.proc.poll() is not None
+                ]
+                if dead:
+                    break
+                if not self._cond.wait(timeout=0.2):
+                    if time.monotonic() >= deadline:
+                        break
+        missing = [s.index for s in self._slots if not s.alive]
+        if missing:
+            self.shutdown(drain=False)
+            raise RuntimeError(
+                f"cluster workers {missing} failed to boot within "
+                f"{self._spawn_timeout_s:.0f}s — check worker stderr "
+                "(spawned processes inherit this process's streams)"
+            )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="ks-router-health", daemon=True
+        )
+        self._health_thread.start()
+        logger.info(
+            "cluster router up on 127.0.0.1:%d — %d worker(s), "
+            "capacity %d", self._port, self._n, self.capacity,
+        )
+        return self
+
+    def _spawn_worker(self, slot: _WorkerSlot) -> None:
+        """Launch one worker as a FRESH interpreter running ``python -m
+        keystone_tpu.cluster.worker`` (spec pickled over stdin) — not a
+        ``multiprocessing`` fork/spawn of this process: a fork would
+        share initialized XLA runtime state, and spawn re-executes the
+        parent's ``__main__``; a clean exec does neither."""
+        import pickle
+        import subprocess
+        import sys
+
+        fault_point(WORKER_SPAWN, replica=slot.index)
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else pkg_root
+        )
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "keystone_tpu.cluster.worker",
+                "127.0.0.1", str(self._port), self._token,
+                str(slot.index),
+            ],
+            stdin=subprocess.PIPE,
+            env=env,
+        )
+        try:
+            proc.stdin.write(
+                pickle.dumps(self._spec, protocol=pickle.HIGHEST_PROTOCOL)
+            )
+            proc.stdin.close()
+        except BrokenPipeError:
+            pass  # instant death: start()/down-handler reports it
+        slot.proc = proc
+        logger.info(
+            "cluster: spawned worker %d (pid %s)", slot.index, proc.pid
+        )
+
+    def _accept_loop(self) -> None:
+        """Match incoming worker connections (hello + ready, token
+        checked) to their slots — runs for the router's life so
+        respawned workers re-register through the same door."""
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed: shutdown
+            try:
+                # short poll interval + an explicit overall deadline:
+                # receives ride out socket timeouts by design, so the
+                # handshake bounds itself with the deadline instead
+                conn.settimeout(1.0)
+                handshake_by = time.monotonic() + self._spawn_timeout_s
+                hello = recv_msg(conn, deadline=handshake_by)
+                if (
+                    hello.get("type") != "hello"
+                    or hello.get("token") != self._token
+                ):
+                    raise ConnectionClosed("bad hello")
+                ready = recv_msg(conn, deadline=handshake_by)
+                if ready.get("type") != "ready":
+                    raise ConnectionClosed(
+                        f"expected ready, got {ready.get('type')!r}"
+                    )
+                # steady state: bounded SENDS (a wedged worker's full
+                # buffer must not hold the send lock forever); receives
+                # ride out timeouts (wire._recv_exact)
+                conn.settimeout(wire_mod.SEND_TIMEOUT_S)
+            except Exception:
+                logger.warning(
+                    "cluster: rejected connection during handshake",
+                    exc_info=True,
+                )
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            self._register_ready(int(hello["worker"]), conn, ready)
+
+    def _register_ready(self, index: int, conn, ready: dict) -> None:
+        slot = self._slots[index]
+        with self._cond:
+            slot.sock = conn
+            slot.alive = True
+            slot.respawning = False
+            slot.capacity = int(ready.get("capacity", 1))
+            slot.ready_report = dict(ready)
+            slot.outstanding = set()
+            slot.recv_thread = threading.Thread(
+                target=self._recv_loop, args=(slot, conn),
+                name=f"ks-router-recv-{index}", daemon=True,
+            )
+            slot.recv_thread.start()
+            parked, self._parked = self._parked, []
+            self._cond.notify_all()
+        logger.info(
+            "cluster: worker %d ready (capacity %d, compiles %s, "
+            "aot_loads %s)", index, slot.capacity,
+            ready.get("compiles"), ready.get("aot_loads"),
+        )
+        # flush requests parked while no worker was live
+        for req in parked:
+            self._route(req, from_requeue=True)
+
+    # -- receive path ----------------------------------------------------
+
+    def _recv_loop(self, slot: _WorkerSlot, conn) -> None:
+        try:
+            while True:
+                msg = recv_msg(conn)
+                kind = msg.get("type")
+                if kind == "res":
+                    self._on_response(slot, msg)
+                elif kind == "pong":
+                    with self._lock:
+                        est = msg.get("service_estimate")
+                        if est is not None:
+                            self._service.observe(float(est))
+                elif kind == "stats":
+                    if msg.get("seq") == slot.stats_seq:
+                        slot.last_snapshot = msg.get("snapshot")
+                        slot.stats_event.set()
+                elif kind == "bye":
+                    return
+        except ConnectionClosed as e:
+            self._on_worker_down(slot, e)
+        except Exception:
+            logger.exception(
+                "cluster: receive loop for worker %d failed", slot.index
+            )
+            self._on_worker_down(
+                slot, ConnectionClosed("receive loop failed")
+            )
+
+    def _on_response(self, slot: _WorkerSlot, msg: dict) -> None:
+        req_id = msg.get("id")
+        with self._lock:
+            req = self._pending.pop(req_id, None)
+            if req is not None:
+                slot.outstanding.discard(req_id)
+            self._cond.notify_all()
+        if req is None:
+            return  # already settled (requeue raced a late answer)
+        if msg.get("ok"):
+            if settle_result(req.future, msg.get("value")):
+                self._metrics.inc("completed")
+                self._metrics.observe_latency(
+                    time.monotonic() - req.enqueued
+                )
+        else:
+            exc = decode_error(msg.get("error") or {})
+            # a decoded worker-side Shed is NOT counted here: the worker
+            # fleet's own registry already counted it, and the merged
+            # snapshot sums both registries — the router's 'shed' means
+            # front-door sheds (its own refusals), nothing else
+            if not isinstance(exc, Shed):
+                self._metrics.inc("worker_errors")
+            settle_future(req.future, exc)
+
+    # -- worker failure --------------------------------------------------
+
+    def _on_worker_down(self, slot: _WorkerSlot, exc: Exception) -> None:
+        with self._lock:
+            if not slot.alive:
+                return  # double report (send failure + recv EOF)
+            slot.alive = False
+            try:
+                if slot.sock is not None:
+                    slot.sock.close()
+            except OSError:
+                pass
+            slot.sock = None
+            orphans = [
+                self._pending.pop(rid)
+                for rid in sorted(slot.outstanding)
+                if rid in self._pending
+            ]
+            slot.outstanding = set()
+            will_restart = (
+                not self._closed and slot.restarts < self._max_restarts
+            )
+            if will_restart:
+                slot.restarts += 1
+                slot.respawning = True
+                self._metrics.inc("restarts")
+            self._cond.notify_all()
+        if self._closed:
+            for req in orphans:
+                settle_future(
+                    req.future,
+                    EngineStopped("router shut down while this request's "
+                                  "worker was down"),
+                )
+            return
+        logger.warning(
+            "cluster: worker %d down (%s) — rerouting %d in-flight "
+            "request(s); restart %s (budget %d/%d used)",
+            slot.index, exc, len(orphans),
+            "scheduled" if will_restart else "refused",
+            slot.restarts, self._max_restarts,
+        )
+        tracer = _trace_current()
+        if tracer is not None:
+            tracer.instant(
+                "fault.worker_down", op_type="ClusterRouter",
+                worker=slot.index, requeued=len(orphans),
+                restarting=will_restart,
+            )
+        moved = 0
+        for req in orphans:
+            if req.future.done():
+                continue
+            req.hops += 1
+            if req.hops > self.MAX_REQUEUE_HOPS:
+                settle_future(req.future, exc)
+                continue
+            if self._route(req, from_requeue=True):
+                moved += 1
+        if moved:
+            self._metrics.inc("requeues", moved)
+        if will_restart:
+            try:
+                self._spawn_worker(slot)
+            except Exception:
+                logger.exception(
+                    "cluster: respawn of worker %d failed", slot.index
+                )
+            else:
+                if tracer is not None:
+                    tracer.instant(
+                        "fault.worker_restart", op_type="ClusterRouter",
+                        worker=slot.index, attempt=slot.restarts,
+                    )
+
+    # -- admission -------------------------------------------------------
+
+    def submit(
+        self, datum: Any, timeout: Optional[float] = None
+    ) -> Future:
+        """Enqueue one datum; returns a Future of its prediction row.
+        Raises typed: :class:`QueueFull` at capacity, :class:`Shed` when
+        the learned estimate says the deadline cannot be met given the
+        aggregate queue depth ÷ fleet capacity, :class:`EngineStopped`
+        after shutdown."""
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                raise EngineStopped("cluster router is shut down")
+            if not self._started:
+                raise RuntimeError(
+                    "submit() needs a started router (call start() or "
+                    "use the context manager)"
+                )
+            depth = len(self._pending) + len(self._parked)
+            if depth >= self._max_queue:
+                self._metrics.inc("rejected")
+                raise QueueFull(
+                    f"router queue at capacity ({self._max_queue})"
+                )
+            if timeout is not None:
+                cap = sum(s.capacity for s in self._slots if s.alive)
+                est = self._service.wait(depth, cap)
+                if now + est > now + timeout:
+                    self._metrics.inc("shed")
+                    raise Shed(
+                        f"deadline unmeetable at the front door: "
+                        f"estimated wait {est:.4f}s exceeds the "
+                        f"request's {timeout:.4f}s budget "
+                        f"(depth {depth} / capacity {cap})"
+                    )
+            req = _PendingReq(
+                datum=datum,
+                deadline=(now + timeout) if timeout is not None else None,
+                enqueued=now,
+            )
+            self._metrics.inc("submitted")
+        self._route(req)
+        return req.future
+
+    def predict(self, datum: Any, timeout: Optional[float] = None) -> Any:
+        return self.submit(datum, timeout=timeout).result()
+
+    def _route(self, req: _PendingReq, from_requeue: bool = False) -> bool:
+        """Place ``req`` on the least-outstanding live worker and send
+        it. Returns True when it was handed to a worker (or parked for a
+        restarting one); settles the future typed otherwise."""
+        while True:
+            with self._lock:
+                if self._closed:
+                    settle_future(
+                        req.future,
+                        EngineStopped("router shut down before dispatch"),
+                    )
+                    return False
+                if from_requeue and req.deadline is not None:
+                    cap = sum(s.capacity for s in self._slots if s.alive)
+                    est = self._service.wait(len(self._pending), cap)
+                    if time.monotonic() + est > req.deadline:
+                        self._metrics.inc("shed")
+                        settle_future(
+                            req.future,
+                            Shed(
+                                "deadline unmeetable after worker "
+                                f"failure: estimated wait {est:.4f}s "
+                                "exceeds the remaining budget"
+                            ),
+                        )
+                        return False
+                live = [s for s in self._slots if s.alive]
+                if not live:
+                    if any(s.respawning for s in self._slots):
+                        self._parked.append(req)
+                        return True
+                    settle_future(
+                        req.future,
+                        EngineStopped(
+                            "no live workers (restart budget exhausted)"
+                        ),
+                    )
+                    return False
+                slot = min(live, key=lambda s: len(s.outstanding))
+                req_id = next(self._req_ids)
+                self._pending[req_id] = req
+                slot.outstanding.add(req_id)
+            try:
+                with slot.send_lock:
+                    send_msg(slot.sock, {
+                        "type": "req",
+                        "id": req_id,
+                        "datum": req.datum,
+                        "deadline_rem": deadline_to_wire(req.deadline),
+                    })
+                return True
+            except Exception as e:
+                # the worker died under us: undo the bookkeeping and let
+                # the down-handler (idempotent) run, then try a peer
+                with self._lock:
+                    self._pending.pop(req_id, None)
+                    slot.outstanding.discard(req_id)
+                self._on_worker_down(
+                    slot, ConnectionClosed(f"send failed: {e}")
+                )
+
+    # -- health + merged metrics ----------------------------------------
+
+    def _health_loop(self) -> None:
+        last_log = 0.0
+        while not self._closed:
+            time.sleep(self._health_interval_s)
+            if self._closed:
+                return
+            self._reap_failed_respawns()
+            with self._lock:
+                live = [s for s in self._slots if s.alive]
+            for slot in live:
+                try:
+                    with slot.send_lock:
+                        send_msg(slot.sock, {
+                            "type": "ping", "t": time.monotonic(),
+                        })
+                except Exception as e:
+                    self._on_worker_down(
+                        slot, ConnectionClosed(f"ping failed: {e}")
+                    )
+            now = time.monotonic()
+            if now - last_log >= self._log_interval_s:
+                last_log = now
+                try:
+                    self._log_merged()
+                except Exception:
+                    logger.exception("cluster: merged metrics log failed")
+
+    def _reap_failed_respawns(self) -> None:
+        """A respawned worker whose process died BEFORE registering
+        (boot crash) would otherwise leave its slot 'respawning' and
+        parked requests waiting forever: retry within the budget, else
+        give the slot up — and if nobody is left to come back, answer
+        everything parked typed."""
+        retry: List[_WorkerSlot] = []
+        with self._lock:
+            for s in self._slots:
+                if not (
+                    s.respawning and s.proc is not None
+                    and s.proc.poll() is not None
+                ):
+                    continue
+                if s.restarts < self._max_restarts and not self._closed:
+                    s.restarts += 1
+                    self._metrics.inc("restarts")
+                    retry.append(s)
+                else:
+                    s.respawning = False
+                    logger.warning(
+                        "cluster: worker %d died during respawn boot "
+                        "and its restart budget is exhausted — giving "
+                        "the slot up", s.index,
+                    )
+            give_up = (
+                not any(s.alive or s.respawning for s in self._slots)
+                and not retry
+            )
+            failed = self._parked if give_up else []
+            if give_up:
+                self._parked = []
+        for req in failed:
+            settle_future(
+                req.future,
+                EngineStopped(
+                    "no live workers remain and the restart budget is "
+                    "exhausted"
+                ),
+            )
+        for s in retry:
+            try:
+                self._spawn_worker(s)
+            except Exception:
+                logger.exception(
+                    "cluster: re-spawn of worker %d failed", s.index
+                )
+
+    def _log_merged(self) -> None:
+        snap = self.snapshot(timeout=1.0)
+        c = snap.get("counters", {})
+        lat = snap.get("latency", {})
+        age = snap.get("queue_age", {})
+        occ = (snap.get("batch_occupancy") or {}).get("ratio")
+        logger.info(
+            "cluster-router: workers=%d/%d outstanding=%d counters=%s "
+            "occupancy=%s shed=%s p99=%s queue_age_p99=%s",
+            sum(1 for s in self._slots if s.alive), self._n,
+            self.outstanding, c,
+            None if occ is None else round(occ, 3),
+            c.get("shed", 0),
+            round(lat["p99"], 4) if "p99" in lat else None,
+            round(age["p99"], 4) if "p99" in age else None,
+        )
+
+    def worker_snapshots(self, timeout: float = 2.0) -> List[dict]:
+        """Fresh metrics snapshots (with quantile sketches) from every
+        live worker, named ``worker-<i>`` — the worker-tier-only view
+        (benches gate on worker-measured latency: it excludes the
+        CLIENT process's own scheduling noise)."""
+        with self._lock:
+            live = [s for s in self._slots if s.alive]
+            for slot in live:
+                slot.stats_seq += 1
+                slot.last_snapshot = None  # stale data never re-served
+                slot.stats_event.clear()
+        for slot in live:
+            try:
+                with slot.send_lock:
+                    send_msg(
+                        slot.sock,
+                        {"type": "stats", "seq": slot.stats_seq},
+                    )
+            except Exception:
+                pass
+        deadline = time.monotonic() + timeout
+        out = []
+        for slot in live:
+            slot.stats_event.wait(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
+            if slot.last_snapshot is not None:
+                snap = dict(slot.last_snapshot)
+                snap["name"] = f"worker-{slot.index}"
+                out.append(snap)
+        return out
+
+    def snapshot(self, timeout: float = 2.0) -> dict:
+        """ONE fleet-wide view: the router's own registry (submissions,
+        front-door sheds, restarts, end-to-end latency) merged with
+        every live worker's snapshot (batches, occupancy, worker-side
+        sheds, queue-age sketches) via :meth:`MetricsRegistry.merge`."""
+        own = self._metrics.snapshot(sketches=True)
+        workers = self.worker_snapshots(timeout=timeout)
+        # every completed request has a latency sample in BOTH tiers
+        # (router end-to-end, worker-internal) — merging both sketches
+        # into one quantile pool would double the count and blend two
+        # populations. The merged 'latency' is the END-TO-END tier;
+        # worker-internal latency stays readable via worker_snapshots().
+        # Worker queue-age sketches have no router counterpart and merge
+        # as-is.
+        workers = [
+            (
+                {**snap, "sketch": {
+                    k: v for k, v in (snap.get("sketch") or {}).items()
+                    if k != "latencies"
+                }}
+                if snap.get("sketch") else snap
+            )
+            for snap in workers
+        ]
+        merged = MetricsRegistry.merge([own] + workers, name="cluster")
+        # 'submitted'/'completed' exist at BOTH tiers for the same
+        # requests (front door and worker fleet) — a blind sum double
+        # counts. The fleet-wide truth is the router's own count; the
+        # worker-tier sum (which can exceed it under requeues) keeps its
+        # own key.
+        c = merged["counters"]
+        for key in ("submitted", "completed"):
+            total, mine = c.get(key, 0), own["counters"].get(key, 0)
+            if total - mine:
+                c[f"worker_{key}"] = total - mine
+            c[key] = mine
+        return merged
+
+    # -- shutdown --------------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM → bounded drain-and-stop (satellite contract: a
+        TERM'd router drains workers with per-process join timeouts and
+        never hangs a smoke run).
+
+        The handler only SPAWNS the shutdown thread: it may interrupt
+        the main thread INSIDE a router critical section, and calling
+        ``shutdown`` (which takes the same non-reentrant lock) from the
+        handler frame would deadlock exactly the path this exists to
+        keep bounded."""
+
+        def _on_term(signum, frame):
+            logger.warning(
+                "cluster: SIGTERM — draining and shutting down"
+            )
+
+            def _stop():
+                self.shutdown(drain=True)
+                if callable(self._prev_sigterm):
+                    self._prev_sigterm(signum, frame)
+
+            threading.Thread(
+                target=_stop, name="ks-router-sigterm", daemon=False
+            ).start()
+
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _on_term)
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop the tier. Bounded: the drain wait, every worker stop,
+        every process join, and every receive-thread join have timeouts;
+        a wedged worker is WARNed, force-killed, and its in-flight
+        requests failed typed. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if drain:
+            deadline = time.monotonic() + self._drain_timeout_s
+            with self._cond:
+                while self._pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        logger.warning(
+                            "cluster shutdown: drain did not finish "
+                            "within %.1fs (%d request(s) in flight; "
+                            "wedged worker?) — failing the remainder",
+                            self._drain_timeout_s, len(self._pending),
+                        )
+                        break
+                    self._cond.wait(timeout=min(0.2, remaining))
+        for slot in self._slots:
+            sock = slot.sock
+            if slot.alive and sock is not None:
+                try:
+                    with slot.send_lock:
+                        send_msg(sock, {"type": "stop", "drain": drain})
+                except Exception:
+                    pass
+        import subprocess
+
+        for slot in self._slots:
+            proc = slot.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=self._join_timeout_s)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    "cluster shutdown: worker %d (pid %s) did not exit "
+                    "within %.1fs — terminating it and failing its "
+                    "in-flight work", slot.index, proc.pid,
+                    self._join_timeout_s,
+                )
+                proc.terminate()
+                try:
+                    proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    try:
+                        proc.wait(timeout=2.0)
+                    except subprocess.TimeoutExpired:
+                        pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for slot in self._slots:
+            slot.alive = False
+            t = slot.recv_thread
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=2.0)
+                if t.is_alive():
+                    logger.warning(
+                        "cluster shutdown: receive thread for worker %d "
+                        "did not exit — abandoning it (daemon)",
+                        slot.index,
+                    )
+        # the belt-and-braces sweep: every admitted request gets an
+        # answer, typed
+        with self._lock:
+            remaining = list(self._pending.values()) + self._parked
+            self._pending.clear()
+            self._parked = []
+        for req in remaining:
+            settle_future(
+                req.future, EngineStopped("cluster router is shut down")
+            )
+        if remaining:
+            logger.warning(
+                "cluster shutdown: failed %d unanswered request(s) typed",
+                len(remaining),
+            )
+
+    def __enter__(self) -> "ClusterRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+
+def settle_result(fut: Future, value: Any) -> bool:
+    """set_result regardless of PENDING/RUNNING state; False when the
+    future was already settled (a requeue raced the original answer)."""
+    if fut.done():
+        return False
+    try:
+        try:
+            if not fut.set_running_or_notify_cancel():
+                return False
+        except Exception:
+            pass  # already RUNNING
+        fut.set_result(value)
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,207 @@
+"""The cluster wire protocol: length-framed pickled messages over a
+local socket, with deadlines and typed errors that survive the process
+boundary.
+
+Deliberately minimal — the router and its workers share one machine (a
+host driving one accelerator slice), so the protocol optimizes for
+correctness of the THREE things that must not be lost crossing a
+process boundary:
+
+* **Framing.** Every message is ``>I`` length prefix + pickle payload.
+  ``send_msg`` holds the caller's per-connection lock (sockets
+  interleave concurrent sends otherwise); ``recv_msg`` reads exactly
+  one frame or raises :class:`ConnectionClosed` on EOF — a half-read
+  frame (peer died mid-send) is indistinguishable from death and is
+  treated as it.
+* **Deadlines.** ``time.monotonic()`` is process-local, so absolute
+  deadlines are meaningless on the wire. A request's deadline travels
+  as its REMAINING budget (seconds), stamped at send time and
+  re-anchored to the receiver's clock on arrival — the satellite
+  contract: crossing the boundary never extends a deadline (transit
+  time comes out of the budget, as it should: it is real latency).
+* **Typed errors.** The serving layer's whole error discipline is that
+  callers branch on types (:class:`~keystone_tpu.serving.errors.Shed`
+  vs :class:`DeadlineExceeded` vs :class:`QueueFull`). Worker-side
+  errors are encoded by REGISTERED name + message and re-raised as the
+  same type router-side; an unregistered type degrades to
+  :class:`WorkerError` carrying the original class name — never a
+  pickle of an arbitrary exception object (which may not unpickle, or
+  may execute reduction code we don't control).
+
+Message payloads are plain dicts with a ``"type"`` key; numpy arrays
+pickle efficiently enough for a localhost hop (protocol 5).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import time
+from typing import Any, Optional
+
+_LEN = struct.Struct(">I")
+
+#: one frame must fit comfortably in memory; a corrupt length prefix
+#: (desynced stream) must not trigger a multi-GB allocation
+MAX_FRAME_BYTES = 1 << 30
+
+
+class ConnectionClosed(ConnectionError):
+    """The peer's socket reached EOF (or died mid-frame). A
+    ``ConnectionError`` so :func:`keystone_tpu.faults.is_transient`
+    classifies it transient — a dead worker's requests are retried on
+    peers, exactly like a dead replica thread's."""
+
+
+class WorkerError(RuntimeError):
+    """A worker-side failure whose type is not part of the serving
+    error vocabulary. Carries the original class name."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+
+
+def _registry():
+    from ..serving.errors import (
+        CanaryMismatch,
+        DeadlineExceeded,
+        EngineClosed,
+        EngineStopped,
+        InvalidRequest,
+        QueueFull,
+        ServingError,
+        Shed,
+    )
+    from ..workflow.pipeline import NotTraceableError
+
+    types = (
+        Shed,
+        DeadlineExceeded,
+        QueueFull,
+        InvalidRequest,
+        EngineStopped,
+        EngineClosed,
+        CanaryMismatch,
+        ServingError,
+        NotTraceableError,
+        WorkerError,
+    )
+    return {t.__name__: t for t in types}
+
+
+#: steady-state socket timeout both sides run with: a SEND that cannot
+#: make progress for this long means the peer stopped reading (wedged /
+#: SIGSTOPped / dead) and is treated as down — a blocking sendall with
+#: no timeout would otherwise hold the per-connection send lock forever
+#: once the kernel buffer fills, unbounding the health loop and the
+#: documented bounded shutdown. RECEIVES simply keep waiting across
+#: timeouts (an idle connection is legitimate); only EOF/errors end them.
+SEND_TIMEOUT_S = 15.0
+
+
+def send_msg(sock: socket.socket, msg: Any) -> None:
+    """Write one framed message. Callers serialize access per socket
+    (the router's per-worker send lock / the worker's reply lock). A
+    ``socket.timeout`` from a full, unread buffer surfaces as
+    :class:`ConnectionClosed` — the peer has effectively left, and a
+    partially-sent frame has desynced the stream anyway."""
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    try:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+    except socket.timeout as e:
+        raise ConnectionClosed(
+            f"peer stopped reading (send stalled {SEND_TIMEOUT_S:.0f}s)"
+        ) from e
+
+
+def recv_msg(sock: socket.socket, deadline: Optional[float] = None) -> Any:
+    """Read exactly one framed message; :class:`ConnectionClosed` on
+    EOF or a torn frame. Socket timeouts while WAITING for a frame are
+    not errors (idle peer) — the wait continues, unless ``deadline``
+    (a ``time.monotonic()`` stamp; the handshake path) passes first."""
+    header = _recv_exact(sock, _LEN.size, deadline)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionClosed(
+            f"frame length {n} exceeds {MAX_FRAME_BYTES} — desynced stream"
+        )
+    return pickle.loads(_recv_exact(sock, n, deadline))
+
+
+def _recv_exact(
+    sock: socket.socket, n: int, deadline: Optional[float] = None
+) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            part = sock.recv(n - len(buf))
+        except socket.timeout:
+            # idle is fine; only EOF/errors/an explicit deadline end it
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ConnectionClosed(
+                    "peer sent nothing before the deadline"
+                ) from None
+            continue
+        except OSError as e:
+            raise ConnectionClosed(f"socket error mid-frame: {e}") from e
+        if not part:
+            raise ConnectionClosed(
+                "peer closed the connection"
+                + (" mid-frame" if buf else "")
+            )
+        buf.extend(part)
+    return bytes(buf)
+
+
+# -- deadlines across the boundary -------------------------------------------
+
+
+def deadline_to_wire(deadline: Optional[float]) -> Optional[float]:
+    """Absolute ``time.monotonic()`` deadline → remaining-seconds budget
+    (clamped at 0: an already-expired deadline stays expired, it does
+    not wrap into a huge budget)."""
+    if deadline is None:
+        return None
+    return max(0.0, deadline - time.monotonic())
+
+
+def deadline_from_wire(remaining: Optional[float]) -> Optional[float]:
+    """Remaining budget → absolute deadline on THIS process's clock."""
+    if remaining is None:
+        return None
+    return time.monotonic() + float(remaining)
+
+
+# -- typed errors across the boundary ----------------------------------------
+
+
+def encode_error(exc: BaseException) -> dict:
+    """One registered serving error (or anything else, degraded) as a
+    wire-safe dict."""
+    kind = type(exc).__name__
+    if kind not in _registry():
+        return {
+            "kind": "WorkerError",
+            "message": str(exc),
+            "original": kind,
+        }
+    return {"kind": kind, "message": str(exc)}
+
+
+def decode_error(enc: dict) -> BaseException:
+    """Reconstruct the typed error; unknown kinds come back as
+    :class:`WorkerError`."""
+    kind = str(enc.get("kind", "WorkerError"))
+    message = str(enc.get("message", ""))
+    cls = _registry().get(kind)
+    if cls is None or cls is WorkerError:
+        return WorkerError(enc.get("original", kind), message)
+    if cls.__name__ == "NotTraceableError":
+        # its __init__ takes the label list, not a message
+        return cls([message])
+    try:
+        return cls(message)
+    except Exception:
+        return WorkerError(kind, message)

@@ -1,0 +1,275 @@
+"""The cluster worker process: one device subset, one local ServingFleet,
+one socket back to the router.
+
+``worker_main`` is the spawn target (module-level, picklable args). A
+worker's life:
+
+1. **Connect + hello.** Dial the router's listener, present the spawn
+   token and worker id (the router refuses strangers — a stray process
+   dialing the port cannot join the fleet).
+2. **Warm boot.** Configure the SHARED AOT cache directory, build the
+   model from the spec (a ``"module:callable"`` factory re-run
+   deterministically, or an explicit pickle), carve this worker's device
+   subset off the mesh data axis
+   (:func:`~keystone_tpu.parallel.placement.worker_device_indices`), and
+   start a local :class:`~keystone_tpu.serving.ServingFleet` over it.
+   ``start()`` pre-warms every bucket AND every manifest signature from
+   the shared cache (``compile/manifest.py`` reads are multi-process
+   safe), so a worker booting against a warm cache pays ZERO traces —
+   the warm-boot contract the ``ready`` message reports (``compiles`` /
+   ``aot_loads``) and the smoke/bench gates assert.
+3. **Serve.** One request message → one ``fleet.submit`` with the
+   deadline re-anchored from its wire budget; the response rides back on
+   the future's completion (replica threads answer out of order — the
+   router matches by request id). Typed serving errors cross the wire by
+   name (:mod:`.wire`), so a worker-side ``Shed`` is a router-side
+   ``Shed``.
+4. **Die loudly or drain cleanly.** ``stop`` drains the local fleet
+   (bounded — the fleet's own shutdown discipline) and answers ``bye``;
+   a dead router (EOF on the socket) shuts the fleet down and exits
+   nonzero. SIGTERM gets the same bounded drain, so an operator's kill
+   never strands in-flight requests silently.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import sys
+import threading
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_model(model_spec: Any):
+    """Build the FittedPipeline a worker serves.
+
+    ``("factory", "module:callable", kwargs)`` imports and calls —
+    the deterministic-rebuild path (same fit ⇒ same AOT fingerprint ⇒
+    warm boot from the shared cache). ``("pickle", bytes)`` unpickles an
+    explicitly shipped model."""
+    kind = model_spec[0]
+    if kind == "factory":
+        import importlib
+
+        path, kwargs = model_spec[1], model_spec[2] or {}
+        mod_name, _, fn_name = path.partition(":")
+        if not fn_name:
+            raise ValueError(
+                f"model factory {path!r} must be 'module:callable'"
+            )
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        return fn(**kwargs)
+    if kind == "pickle":
+        import pickle
+
+        return pickle.loads(model_spec[1])
+    raise ValueError(f"unknown model spec kind {kind!r}")
+
+
+def _worker_devices(worker_id: int, n_workers: int, replicas: Optional[int]):
+    """This worker's replica→device list: its contiguous slice of the
+    mesh data axis, round-robined up to ``replicas`` when more workers
+    than devices (or an explicit replica count) ask for co-residents."""
+    from ..parallel.placement import data_axis_devices, worker_device_indices
+
+    devs = data_axis_devices()
+    idxs = worker_device_indices(worker_id, n_workers)
+    n = replicas if replicas is not None else len(idxs)
+    return [devs[idxs[i % len(idxs)]] for i in range(max(1, n))]
+
+
+def worker_main(host: str, port: int, token: str, worker_id: int,
+                spec: dict) -> int:
+    """Spawn-target entry point; returns the process exit code."""
+    logging.basicConfig(
+        level=getattr(
+            logging, str(spec.get("log_level", "warning")).upper(),
+            logging.WARNING,
+        ),
+        format=(
+            f"[worker-{worker_id}] %(levelname)s %(name)s: %(message)s"
+        ),
+    )
+    if spec.get("virtual_devices"):
+        from ..parallel.virtual import provision_virtual_devices
+
+        provision_virtual_devices(int(spec["virtual_devices"]))
+    if spec.get("aot_cache"):
+        from .. import compile as compile_mod
+
+        compile_mod.configure(spec["aot_cache"])
+
+    from ..serving import ServingFleet
+    from .wire import (
+        ConnectionClosed,
+        deadline_from_wire,
+        encode_error,
+        recv_msg,
+        send_msg,
+    )
+
+    from .wire import SEND_TIMEOUT_S
+
+    sock = socket.create_connection((host, port), timeout=30.0)
+    # bounded sends, timeout-tolerant receives (see wire.SEND_TIMEOUT_S)
+    sock.settimeout(SEND_TIMEOUT_S)
+    send_lock = threading.Lock()
+
+    def reply(msg: dict) -> None:
+        with send_lock:
+            send_msg(sock, msg)
+
+    reply({
+        "type": "hello", "token": token, "worker": worker_id,
+        "pid": os.getpid(),
+    })
+
+    fitted = resolve_model(spec["model"])
+    devices = _worker_devices(
+        worker_id, int(spec.get("n_workers", 1)), spec.get("replicas")
+    )
+    fleet = ServingFleet(
+        fitted,
+        devices=devices,
+        buckets=tuple(spec.get("buckets") or (1, 8, 32, 64)),
+        datum_shape=spec.get("datum_shape"),
+        dtype=spec.get("dtype"),
+        max_queue=int(spec.get("max_queue", 1024)),
+        max_wait_ms=float(spec.get("max_wait_ms", 2.0)),
+    )
+    fleet.start(warmup=spec.get("warmup"))
+    snap = fleet.metrics.snapshot()
+    reply({
+        "type": "ready",
+        "worker": worker_id,
+        "compiles": snap["counters"].get("compiles", 0),
+        "aot_loads": snap["counters"].get("aot_loads", 0),
+        "capacity": fleet.n_replicas * fleet.policy.max_size,
+        "replicas": fleet.n_replicas,
+        "devices": [str(d) for d in devices],
+    })
+    logger.info(
+        "worker %d ready: %d replica(s) on %s (compiles=%d aot_loads=%d)",
+        worker_id, fleet.n_replicas, [str(d) for d in devices],
+        snap["counters"].get("compiles", 0),
+        snap["counters"].get("aot_loads", 0),
+    )
+
+    stopping = threading.Event()
+
+    def _drain_and_exit(signum, frame):
+        # bounded by the fleet's own drain/join timeouts — and run on a
+        # SPAWNED thread, never in the handler frame: the signal may
+        # interrupt the main thread INSIDE fleet.submit holding the
+        # scheduler's non-reentrant lock, and shutdown() takes that same
+        # lock (the router's handler avoids the identical deadlock)
+        if stopping.is_set():
+            return
+        stopping.set()
+
+        def _stop():
+            try:
+                fleet.shutdown(drain=True)
+            finally:
+                os._exit(0)
+
+        threading.Thread(
+            target=_stop, name="ks-worker-sigterm", daemon=False
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _drain_and_exit)
+    except ValueError:
+        pass  # non-main thread (embedded use): router stop still works
+
+    def _answer(req_id: int, fut) -> None:
+        try:
+            value = fut.result()
+            reply({"type": "res", "id": req_id, "ok": True, "value": value})
+        except BaseException as e:  # noqa: BLE001 — typed over the wire
+            try:
+                reply({
+                    "type": "res", "id": req_id, "ok": False,
+                    "error": encode_error(e),
+                })
+            except Exception:
+                pass  # router gone; its death handling requeues
+
+    rc = 0
+    try:
+        while True:
+            msg = recv_msg(sock)
+            kind = msg.get("type")
+            if kind == "req":
+                req_id = msg["id"]
+                deadline = deadline_from_wire(msg.get("deadline_rem"))
+                try:
+                    import time as _time
+
+                    timeout = (
+                        None if deadline is None
+                        else max(0.0, deadline - _time.monotonic())
+                    )
+                    fut = fleet.submit(msg["datum"], timeout=timeout)
+                except BaseException as e:  # Shed/QueueFull/... typed back
+                    reply({
+                        "type": "res", "id": req_id, "ok": False,
+                        "error": encode_error(e),
+                    })
+                    continue
+                fut.add_done_callback(
+                    lambda f, rid=req_id: _answer(rid, f)
+                )
+            elif kind == "ping":
+                reply({
+                    "type": "pong",
+                    "t": msg.get("t"),
+                    "service_estimate": fleet.scheduler.service_estimate,
+                })
+            elif kind == "stats":
+                reply({
+                    "type": "stats",
+                    "worker": worker_id,
+                    "seq": msg.get("seq"),
+                    "snapshot": fleet.metrics.snapshot(sketches=True),
+                })
+            elif kind == "stop":
+                fleet.shutdown(drain=bool(msg.get("drain", True)))
+                reply({"type": "bye", "worker": worker_id})
+                break
+            else:
+                logger.warning("worker %d: unknown message %r", worker_id, kind)
+    except ConnectionClosed:
+        if not stopping.is_set():
+            logger.warning(
+                "worker %d: router connection lost — shutting down", worker_id
+            )
+            rc = 1
+    finally:
+        try:
+            fleet.shutdown(drain=False)
+        except Exception:
+            logger.exception("worker %d: fleet shutdown failed", worker_id)
+        try:
+            sock.close()
+        except OSError:
+            pass
+    return rc
+
+
+def main(argv=None) -> int:  # pragma: no cover - exercised via spawn
+    """Debug entry: ``python -m keystone_tpu.cluster.worker host port
+    token worker_id`` with the spec pickled on stdin."""
+    import pickle
+
+    host, port, token, worker_id = argv or sys.argv[1:5]
+    spec = pickle.load(sys.stdin.buffer)
+    return worker_main(host, int(port), token, int(worker_id), spec)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

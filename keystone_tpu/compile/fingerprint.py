@@ -206,6 +206,12 @@ def _feed_object(h, value: Any, path: str) -> None:
     if isinstance(value, Operator):
         _feed_operator_state(h, value, path)
         return
+    if isinstance(value, types.ModuleType):
+        # same rule as module GLOBALS: digest by name (a module's
+        # contents are the environment key's business). Function-local
+        # imports are idiomatic here, and they land in closure cells.
+        _feed_bytes(h, b"m", value.__name__.encode())
+        return
     try:
         import jax
 

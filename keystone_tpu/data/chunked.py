@@ -89,6 +89,16 @@ class _InjectedChunks:
         if close is not None:
             close()
 
+    @property
+    def shards(self) -> int:
+        """Producer shards behind this seam (ScanPipeline reads it for
+        the span's production-split attrs)."""
+        return getattr(self._it, "shards", 1)
+
+    @property
+    def shard_chunks(self):
+        return getattr(self._it, "shard_chunks", None)
+
 
 def _maybe_inject(it: Iterator[Any], label: str) -> Iterator[Any]:
     """Wrap ``it`` with the fault seam iff a plan is active (one dict
@@ -192,12 +202,14 @@ class ChunkedDataset(Dataset):
         super().__init__(chunk_factory, batched=True)
         self._num_rows = int(num_rows)
         self._label = label or "chunked"
-        #: optional ``fn(start) -> iterator`` yielding chunks from index
-        #: ``start`` WITHOUT producing the skipped prefix — set by the
-        #: indexable constructors (from_array / from_chunk_fn) and
-        #: propagated through map/map_batch, so a checkpoint-resumed fit
-        #: re-enters the stream at its cursor instead of rescanning
-        self._skip_factory: Optional[Callable[[int], Iterator[Any]]] = None
+        #: optional ``fn(start, step=1) -> iterator`` yielding chunk
+        #: indices ``start, start+step, …`` WITHOUT producing the
+        #: skipped ones — set by the indexable constructors (from_array /
+        #: from_chunk_fn) and propagated through map/map_batch. ``step=1``
+        #: is the checkpoint-resume hook (re-enter at a cursor instead of
+        #: rescanning); ``step=N`` is the sharded-production hook (shard
+        #: s of N produces s, s+N, … — see :mod:`~keystone_tpu.data.shards`)
+        self._skip_factory: Optional[Callable[..., Iterator[Any]]] = None
 
     # ---- constructors ---------------------------------------------------
 
@@ -208,8 +220,8 @@ class ChunkedDataset(Dataset):
         if chunk_rows <= 0:
             raise ValueError("chunk_rows must be positive")
 
-        def from_chunk(start: int):
-            for i in range(start * chunk_rows, n, chunk_rows):
+        def from_chunk(start: int, step: int = 1):
+            for i in range(start * chunk_rows, n, chunk_rows * step):
                 yield arr[i : i + chunk_rows]
 
         ds = ChunkedDataset(
@@ -237,9 +249,11 @@ class ChunkedDataset(Dataset):
         budget, instead of failing the scan on the first flake."""
         from ..faults import SCAN_CHUNK, RetryBudget, retry_call
 
-        def from_chunk(start: int):
+        def from_chunk(start: int, step: int = 1):
+            # one regeneration budget per iterator — a shard's retries
+            # are bounded exactly as the single producer's were
             budget = RetryBudget(label=f"chunk_fn[{label or 'chunked'}]")
-            for i in range(start, num_chunks):
+            for i in range(start, num_chunks, step):
                 yield retry_call(
                     lambda i=i: chunk_fn(i), budget, SCAN_CHUNK,
                     inject=False,
@@ -260,11 +274,13 @@ class ChunkedDataset(Dataset):
     def __len__(self) -> int:
         return self._num_rows
 
-    def chunks(self, lanes: Optional[int] = None) -> Iterator[Any]:
+    def chunks(
+        self, lanes: Optional[int] = None, shards: Optional[int] = None
+    ) -> Iterator[Any]:
         """One scan: recomputes the whole lazy chain chunk-by-chunk.
 
         Runs through the pipelined scan runtime (``pipeline_scan.py``):
-        the chain executes in a background producer thread while an H2D
+        the chain executes in a background producer while an H2D
         staging ring keeps device uploads ahead of the consumer, so host
         production, transfer, and device compute overlap on every
         streaming consumer. ``lanes`` round-robins chunks across that many
@@ -272,17 +288,44 @@ class ChunkedDataset(Dataset):
         scan) — pass it ONLY from consumers that keep per-lane partial
         accumulators; the default single-lane scan is what ``to_array``/
         ``cache`` and other whole-stream consumers need.
+
+        ``shards`` (default ``KEYSTONE_SCAN_SHARDS``) splits chunk
+        PRODUCTION across that many producer shards partitioning the
+        chunk index space — the host-side counterpart of lanes, for
+        index-addressable chains (:mod:`~keystone_tpu.data.shards`); the
+        merged stream is bit-identical to the single producer's.
         ``KEYSTONE_SCAN_PIPELINE=0`` restores the serial in-thread scan."""
         return scan_pipeline(
-            _maybe_inject(iter(self._payload()), self._label),
+            self._production(shards),
             label=self._label, lanes=lanes or 1,
+        )
+
+    def _production(self, shards: Optional[int] = None) -> Iterator[Any]:
+        """The produced (pre-staging) chunk stream: sharded across
+        producer shards when asked and possible, single otherwise; the
+        fault-injection seam wraps the MERGED stream either way, so
+        chaos-schedule indices follow chunk order deterministically."""
+        from .shards import maybe_shard
+
+        return _maybe_inject(
+            maybe_shard(
+                self._skip_factory,
+                lambda: iter(self._payload()),
+                shards=shards,
+                label=self._label,
+            ),
+            self._label,
         )
 
     def raw_chunks(self, skip: int = 0) -> Iterator[Any]:
         """One scan WITHOUT the pipelined runtime — for composition sites
         that feed another scan (derived factories, solvers that wrap the
         source in their own ``scan_pipeline``) where nesting pipelines
-        would stack threads for no additional overlap.
+        would stack threads for no additional overlap. Under
+        ``KEYSTONE_SCAN_SHARDS > 1`` production still shards (the N
+        producer threads replace the absent pipeline thread; the solver
+        scans that wrap this in ``scan_pipeline`` are exactly where the
+        producer bottleneck lives).
 
         ``skip`` starts the scan at chunk index ``skip`` — the
         checkpoint-resume hook. Indexable sources (and chains built on
@@ -290,10 +333,18 @@ class ChunkedDataset(Dataset):
         opaque factories fall back to producing and discarding it (the
         resume still skips the fold work, just not the production)."""
         if skip <= 0:
-            return _maybe_inject(iter(self._payload()), self._label)
+            return self._production()
         if self._skip_factory is not None:
+            from .shards import maybe_shard
+
             return _maybe_inject(
-                iter(self._skip_factory(skip)), self._label
+                maybe_shard(
+                    self._skip_factory,
+                    lambda: iter(self._skip_factory(skip)),
+                    start=skip,
+                    label=self._label,
+                ),
+                self._label,
             )
         it = iter(self._payload())
         for _ in range(skip):
@@ -405,9 +456,10 @@ class ChunkedDataset(Dataset):
             factory, self._num_rows, label=f"{self._label}|map_batch"
         )
         if parent_skip is not None:
-            # skipping the parent also skips fn over the skipped prefix
-            ds._skip_factory = lambda start: (
-                fn(c) for c in parent_skip(start)
+            # striding the parent also strides fn over the skipped
+            # chunks — a shard runs the WHOLE chain for its indices
+            ds._skip_factory = lambda start, step=1: (
+                fn(c) for c in parent_skip(start, step)
             )
         return ds
 
@@ -456,7 +508,9 @@ class ChunkedDataset(Dataset):
             label=f"{self._label}|map",
         )
         if parent_skip is not None:
-            ds._skip_factory = lambda start: run(parent_skip(start))
+            ds._skip_factory = lambda start, step=1: run(
+                parent_skip(start, step)
+            )
         return ds
 
     def cache(self, budget_bytes: Optional[int] = None) -> Dataset:

@@ -191,6 +191,12 @@ class ScanStats:
     collectives: int = 0
     #: transient-failure retries consumed from the scan's RetryBudget
     retries: int = 0
+    #: producer shards feeding this scan (data/shards.py); 1 = the
+    #: single-producer path, no shard accounting
+    shards: int = 1
+    #: chunks produced per shard (len == shards when shards > 1) —
+    #: production skew is the host-side straggler signal
+    shard_chunks: List[int] = field(default_factory=list)
 
 
 _CHUNK, _ERROR, _DONE = 0, 1, 2
@@ -306,6 +312,11 @@ class ScanPipeline:
         self._retry = (
             getattr(source, "retry_budget", None)
             or RetryBudget(label=f"scan[{label}]")
+        )
+        # a sharded producer feeding this scan stamps its production
+        # split onto the span at shutdown (counts grow until then)
+        self._shard_source = (
+            source if getattr(source, "shards", 1) > 1 else None
         )
         if self._devices is not None:
             self.stats.lanes = self._lanes
@@ -439,6 +450,11 @@ class ScanPipeline:
         self._recorded = True
         self.stats.end = time.perf_counter()
         self.stats.retries = self._retry.attempts
+        if self._shard_source is not None:
+            self.stats.shards = int(self._shard_source.shards)
+            self.stats.shard_chunks = list(
+                getattr(self._shard_source, "shard_chunks", []) or []
+            )
         try:
             from ..obs.scan import record_scan_span
 

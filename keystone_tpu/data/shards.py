@@ -1,0 +1,259 @@
+"""Sharded chunk production: N producer shards partition the chunk index
+space of one out-of-core scan.
+
+Every pipelined scan used to be fed by ONE producer thread running the
+whole lazy chunk chain — tar decode, host featurizers, per-item maps —
+while the staging lanes and the device waited on it. The Spark-perf
+study (PAPERS.md #3) calls this the driver/host bottleneck, and it is
+exactly the shape the reference never has: RDD *partitions* produce in
+parallel. :class:`ShardedChunkProducer` is that counterpart for the
+chunk-factory world: shard ``s`` of ``N`` produces chunk indices
+``s, s+N, s+2N, …`` through the dataset's stride factory (each shard
+runs the WHOLE lazy chain for its indices — production cost genuinely
+splits), and the consumer-side merge pops the per-shard queues
+round-robin in index order, so the merged stream is **bit-identical**
+to the single-producer scan: same chunks, same order, same values.
+
+The seam is deliberately process-shaped: a shard is "anything that
+yields chunk ``s, s+N, …`` into a bounded queue". Today's shards are
+threads (the chunk chains are numpy/JAX host work that releases the
+GIL; a thread per shard already overlaps production on shared cores) —
+a process-backed shard only has to speak the same queue protocol.
+
+Contracts preserved from the single-producer scan:
+
+* **Order** — the merge is strict round-robin by index; a fast shard
+  waits in its queue, never overtakes.
+* **Errors** — a shard failure surfaces in the consumer AT THE INDEX it
+  occurred (chunks before it are still delivered), with the original
+  traceback.
+* **Early exit** — ``close()`` (or garbage collection) stops every
+  shard, drains the queues so blocked puts unblock, and joins the
+  threads: no orphans, no deadlock.
+* **Fault injection** — the ``scan.chunk`` fault point stays OUTSIDE,
+  at the merged-iterator seam (``chunked._maybe_inject`` wraps the
+  producer), so a chaos schedule's invocation indices match the merged
+  chunk order deterministically regardless of shard interleaving.
+* **Retry budgets** — a ``from_chunk_fn`` source's per-index
+  regeneration retries ride inside each shard's own iterator, bounded
+  per shard exactly as the single producer bounds its one iterator.
+
+``KEYSTONE_SCAN_SHARDS`` (default 1 = today's single producer) sets the
+shard count; sources without a stride factory (opaque generators) fall
+back to one producer with a rate-limited log line, never an error.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from queue import Empty, Full, Queue
+from typing import Any, Callable, Iterator, List, Optional
+
+from ..utils import env_int as _env_int
+from ..utils.obs import every as _log_every
+
+logger = logging.getLogger(__name__)
+
+#: per-shard queue depth: how far one shard may run ahead of the merge
+DEFAULT_SHARD_DEPTH = 2
+_JOIN_TIMEOUT = 5.0
+
+_CHUNK, _ERROR, _DONE = 0, 1, 2
+
+
+def scan_shards() -> int:
+    """Producer shards per scan: ``KEYSTONE_SCAN_SHARDS``, default 1
+    (single producer, byte-identical to the pre-shard path). Read per
+    scan so tests and benches can flip it."""
+    return _env_int("KEYSTONE_SCAN_SHARDS", 1)
+
+
+def _shard_loop(
+    it: Iterator[Any],
+    q: Queue,
+    stop: threading.Event,
+    counts: List[int],
+    shard: int,
+) -> None:
+    """One shard's thread body: run the stride iterator into the bounded
+    queue. Module-level for the same reason as the scan pipeline's
+    producer: the thread must not pin the owning producer object."""
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except Full:
+                continue
+        return False
+
+    try:
+        while not stop.is_set():
+            try:
+                chunk = next(it)
+            except StopIteration:
+                break
+            if not put((_CHUNK, chunk)):
+                return
+            counts[shard] += 1
+    except BaseException as e:  # noqa: BLE001 — surfaces in the consumer
+        put((_ERROR, e))
+        return
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                if _log_every("shards.source_close", 30.0):
+                    logger.warning(
+                        "sharded scan: shard %d source close() failed",
+                        shard, exc_info=True,
+                    )
+    put((_DONE, None))
+
+
+class ShardedChunkProducer:
+    """Order-preserving merge of N shard producers over one stride
+    factory (``fn(start, step) -> iterator of chunks start, start+step,
+    …``). Iterate it like any chunk source; hand it to
+    ``scan_pipeline`` as the scan's source."""
+
+    def __init__(
+        self,
+        stride_factory: Callable[[int, int], Iterator[Any]],
+        shards: int,
+        *,
+        start: int = 0,
+        depth: int = DEFAULT_SHARD_DEPTH,
+        label: str = "scan",
+    ):
+        if shards < 2:
+            raise ValueError(
+                f"ShardedChunkProducer needs >= 2 shards, got {shards} "
+                "(1 shard IS the single-producer path)"
+            )
+        self.shards = int(shards)
+        self.label = label
+        #: chunks produced per shard — the span's skew/straggler signal
+        self.shard_chunks: List[int] = [0] * self.shards
+        self._queues: List[Queue] = [
+            Queue(maxsize=max(1, depth)) for _ in range(self.shards)
+        ]
+        self._stop = threading.Event()
+        self._next = 0  # merged chunk cursor; pops queue _next % shards
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+        for s in range(self.shards):
+            t = threading.Thread(
+                target=_shard_loop,
+                args=(
+                    iter(stride_factory(start + s, self.shards)),
+                    self._queues[s],
+                    self._stop,
+                    self.shard_chunks,
+                    s,
+                ),
+                name=f"ks-shard[{label}]{s}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def __iter__(self) -> "ShardedChunkProducer":
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise StopIteration
+        s = self._next % self.shards
+        kind, payload = self._get(s)
+        if kind == _CHUNK:
+            self._next += 1
+            return payload
+        # _DONE from shard s means chunk index `self._next` does not
+        # exist — and chunk indices are dense, so nothing beyond it
+        # exists either: the scan is over regardless of what later
+        # shards still hold (they can only hold SMALLER indices already
+        # consumed, or nothing).
+        self.close()
+        if kind == _ERROR:
+            raise payload
+        raise StopIteration
+
+    def _get(self, s: int):
+        q = self._queues[s]
+        t = self._threads[s]
+        while True:
+            try:
+                return q.get(timeout=0.1)
+            except Empty:
+                if not t.is_alive():
+                    try:
+                        return q.get_nowait()
+                    except Empty:
+                        raise RuntimeError(
+                            f"sharded scan[{self.label}]: shard {s} died "
+                            "without finishing its index range"
+                        ) from None
+
+    def close(self) -> None:
+        """Stop every shard, drain the queues, join the threads."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        for q in self._queues:
+            while True:
+                try:
+                    q.get_nowait()
+                except Empty:
+                    break
+        deadline = time.monotonic() + _JOIN_TIMEOUT
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive() and _log_every("shards.join", 30.0):
+                logger.warning(
+                    "sharded scan[%s]: shard thread %s did not exit "
+                    "within %.1fs — abandoning it (daemon)",
+                    self.label, t.name, _JOIN_TIMEOUT,
+                )
+
+    def __del__(self):
+        try:
+            if not self._closed:
+                self.close()
+        except Exception:
+            pass
+
+
+def maybe_shard(
+    stride_factory: Optional[Callable[[int, int], Iterator[Any]]],
+    fallback: Callable[[], Iterator[Any]],
+    *,
+    shards: Optional[int] = None,
+    start: int = 0,
+    label: str = "scan",
+) -> Iterator[Any]:
+    """The one decision point: a sharded producer when the knob asks for
+    one AND the source can stride, else the plain single-producer
+    iterator. An opaque source under ``KEYSTONE_SCAN_SHARDS > 1`` logs
+    (rate-limited) and falls back — sharding is an optimization, never
+    a requirement."""
+    n = scan_shards() if shards is None else int(shards)
+    if n <= 1:
+        return fallback()
+    if stride_factory is None:
+        if _log_every(f"shards.fallback:{label}", 30.0):
+            logger.info(
+                "scan[%s]: KEYSTONE_SCAN_SHARDS=%d requested but the "
+                "chunk source is not index-addressable — producing "
+                "single-threaded", label, n,
+            )
+        return fallback()
+    return ShardedChunkProducer(
+        stride_factory, n, start=start, label=label
+    )

@@ -25,6 +25,7 @@ from .plan import (
     REPLICA_BATCH,
     SCAN_CHUNK,
     SCAN_STAGE,
+    WORKER_SPAWN,
     FatalFaultInjected,
     FaultInjected,
     FaultPlan,
@@ -43,6 +44,7 @@ from .retry import RetryBudget, retry_call
 
 __all__ = [
     "AOT_READ",
+    "WORKER_SPAWN",
     "REPLICA_BATCH",
     "SCAN_CHUNK",
     "SCAN_STAGE",

@@ -72,6 +72,9 @@ SCAN_STAGE = "scan.stage"
 REPLICA_BATCH = "replica.batch"
 #: one AOT executable-cache read (degrades to a miss on transient fault)
 AOT_READ = "aot.read"
+#: one cluster worker-process spawn attempt (router side, before fork —
+#: transient => the router's spawn retry/restart budget absorbs it)
+WORKER_SPAWN = "worker.spawn"
 
 _KINDS = ("transient", "fatal", "kill")
 

@@ -56,6 +56,12 @@ def record_scan_span(stats):
         # transient-failure retries the scan's budget absorbed — stamped
         # only when nonzero so fault-free traces keep their schema
         attrs["retries"] = stats.retries
+    if getattr(stats, "shards", 1) > 1:
+        # producer shards (host-side production split over the chunk
+        # index space, data/shards.py); per-shard chunk counts are the
+        # production-skew signal, same role lane_bytes plays for staging
+        attrs["shards"] = stats.shards
+        attrs["shard_chunks"] = list(stats.shard_chunks)
     if stats.lanes > 1:
         attrs.update(
             lanes=stats.lanes,

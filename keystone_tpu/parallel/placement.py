@@ -28,6 +28,28 @@ def data_axis_devices(mesh=None) -> List[Any]:
     return list(m.devices.flat)
 
 
+def worker_device_indices(
+    worker_id: int, n_workers: int, mesh=None
+) -> List[int]:
+    """The data-axis device indices one cluster worker PROCESS owns:
+    a balanced contiguous partition of the axis across ``n_workers``
+    (worker ``w`` of ``W`` over ``D`` devices owns ``[wD/W, (w+1)D/W)``),
+    so the process tier carves the mesh the same way the thread tier
+    carves it into replicas. More workers than devices yields
+    co-resident workers (``[w % D]``) — the CPU/1-device case, where
+    separate processes still overlap host-side work across GILs."""
+    if not 0 <= worker_id < n_workers:
+        raise ValueError(
+            f"worker_id {worker_id} outside [0, {n_workers})"
+        )
+    n_dev = len(data_axis_devices(mesh))
+    if n_dev < n_workers:
+        return [worker_id % n_dev]
+    lo = worker_id * n_dev // n_workers
+    hi = (worker_id + 1) * n_dev // n_workers
+    return list(range(lo, hi))
+
+
 def replica_devices(
     n: Optional[int] = None, mesh=None
 ) -> List[Any]:

@@ -1,6 +1,8 @@
 """``--serve-demo``: fit a small pipeline, push synthetic traffic through
 the engine — or, with ``--replicas N``, through a continuous-batching
-:class:`~keystone_tpu.serving.fleet.ServingFleet` — print the metrics
+:class:`~keystone_tpu.serving.fleet.ServingFleet`, or, with
+``--workers N`` (or ``KEYSTONE_WORKERS``), through the multi-process
+:class:`~keystone_tpu.cluster.ClusterRouter` — print the metrics
 snapshot. The smoke path behind ``bin/serve-smoke.sh`` and the CLI's
 ``--serve-demo`` flag.
 """
@@ -8,6 +10,7 @@ snapshot. The smoke path behind ``bin/serve-smoke.sh`` and the CLI's
 from __future__ import annotations
 
 import argparse
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
@@ -55,6 +58,79 @@ def build_demo_fitted(
     return fitted, np.asarray(test.data.to_array())
 
 
+def _serve_through_cluster(args, fitted, data, buckets) -> int:
+    """The ``--workers N`` path: a ClusterRouter over N worker processes,
+    each rebuilding the SAME deterministic pipeline (same fingerprint ⇒
+    warm boot from the shared AOT cache when one is configured) and
+    serving it from a local fleet of ``--replicas`` replicas."""
+    from .. import compile as compile_mod
+    from ..cluster import ClusterRouter
+
+    cache = compile_mod.get_cache()
+    router = ClusterRouter(
+        ("factory", "keystone_tpu.cluster.demo:build_demo_model", {
+            "num_ffts": args.numFFTs, "block_size": args.blockSize,
+            "lam": args.lam, "n_train": args.nTrain,
+        }),
+        workers=args.workers,
+        replicas_per_worker=max(1, args.replicas),
+        buckets=buckets,
+        datum_shape=data.shape[1:],
+        max_queue=args.maxQueue,
+        max_wait_ms=args.maxWaitMs,
+        aot_cache=cache.root if cache is not None else None,
+    )
+    router.install_signal_handlers()
+    with router:
+        with ThreadPoolExecutor(max_workers=args.clients) as pool:
+            preds = list(pool.map(
+                lambda row: router.predict(row, timeout=120.0), data
+            ))
+        snap = router.snapshot()
+        reports = [r for r in router.worker_reports if r]
+    expected = (
+        np.asarray(fitted.apply(data).to_array())
+        if len(data) else np.array([])
+    )
+    agree = int(np.sum(np.asarray(preds).ravel() == expected.ravel()))
+    c = snap["counters"]
+    lat = snap["latency"]
+    compiles = sum(r.get("compiles", 0) for r in reports)
+    aot_loads = sum(r.get("aot_loads", 0) for r in reports)
+    worker_batches = {}
+    for key, row in snap.get("replicas", {}).items():
+        w = key.split("/")[0]
+        worker_batches[w] = worker_batches.get(w, 0) + row.get("batches", 0)
+    print(
+        f"SERVE ok={agree}/{len(data)} compiles={compiles} "
+        f"aot_loads={aot_loads} batches={c.get('batches', 0)} "
+        f"completed={c.get('completed', 0)} "
+        f"p50={lat.get('p50', 0):.4f}s p99={lat.get('p99', 0):.4f}s "
+        f"workers={args.workers} shed={c.get('shed', 0)} "
+        f"restarts={c.get('restarts', 0)} "
+        f"per_worker_batches={worker_batches}"
+    )
+    ok = agree == len(data) and c.get("completed", 0) == len(data)
+    if len(reports) < args.workers:
+        print(f"SERVE FAIL: only {len(reports)}/{args.workers} workers ready")
+        ok = False
+    # the router must actually spread load: every worker PROCESS served
+    # at least one micro-batch
+    if len(worker_batches) < args.workers or any(
+        b < 1 for b in worker_batches.values()
+    ):
+        print(f"SERVE FAIL: idle worker (batches {worker_batches})")
+        ok = False
+    if args.expect_zero_compiles and compiles != 0:
+        print(
+            f"SERVE FAIL: warm worker boots paid {compiles} trace(s), "
+            "expected 0 (shared AOT cache + manifest)"
+        )
+        ok = False
+    print("SERVE " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser("keystone-tpu serve-demo")
     p.add_argument("--numFFTs", type=int, default=2)
@@ -67,6 +143,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="serve from a ServingFleet of N replica workers (continuous "
              "batching + work stealing) instead of the single-worker "
              "engine; default 1 = ServingEngine",
+    )
+    p.add_argument(
+        "--workers", type=int,
+        default=int(os.environ.get("KEYSTONE_WORKERS", "0") or 0),
+        help="serve from a multi-process ClusterRouter of N worker "
+             "processes (each a local fleet of --replicas workers, "
+             "sharing the AOT cache dir for warm boots); default 0 = "
+             "in-process serving (also: KEYSTONE_WORKERS)",
     )
     p.add_argument("--buckets", default="8,32",
                    help="comma-separated static batch-size buckets")
@@ -92,6 +176,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         n_train=args.nTrain, n_test=args.requests,
     )
     data = test_data[: args.requests]
+    if args.workers > 0:
+        return _serve_through_cluster(args, fitted, data, buckets)
     if args.replicas > 1:
         engine = ServingFleet(
             fitted,

@@ -35,7 +35,7 @@ import logging
 import math
 import threading
 from collections import defaultdict, deque
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Sequence
 
 from ..utils import timing
 from ..utils.obs import every
@@ -135,9 +135,15 @@ class MetricsRegistry:
             out[f"p{int(q * 100)}"] = vals[idx]
         return out
 
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self, sketches: bool = False) -> Dict[str, object]:
         """Everything at once: counters, evaluated gauges, occupancy,
-        latency quantiles, and the process phase-timing table."""
+        latency quantiles, and the process phase-timing table.
+
+        ``sketches=True`` additionally includes the raw bounded latency /
+        queue-age reservoirs under ``"sketch"`` — the mergeable form a
+        worker process ships to the cluster router so :meth:`merge` can
+        recompute exact fleet-wide quantiles instead of averaging
+        per-process percentiles (which is statistically meaningless)."""
         with self._lock:
             counters = dict(self._counters)
             gauges = list(self._gauges.items())
@@ -145,7 +151,15 @@ class MetricsRegistry:
             replicas = {
                 idx: list(row) for idx, row in self._replica_batches.items()
             }
-        return {
+            sketch = (
+                {
+                    "latencies": [float(x) for x in self._latencies],
+                    "queue_ages": [float(x) for x in self._queue_ages],
+                }
+                if sketches
+                else None
+            )
+        snap: Dict[str, object] = {
             "name": self.name,
             "counters": counters,
             "gauges": {k: read() for k, read in gauges},
@@ -167,6 +181,81 @@ class MetricsRegistry:
             "queue_age": self.queue_age_quantiles(),
             "phases": timing.snapshot(prefix="serve."),
             "spans": self._span_summary(),
+        }
+        if sketch is not None:
+            snap["sketch"] = sketch
+        return snap
+
+    @staticmethod
+    def merge(
+        snapshots: "Sequence[Dict[str, object]]", name: str = "merged"
+    ) -> Dict[str, object]:
+        """Aggregate N process/worker snapshots into ONE snapshot-shaped
+        view: counters and occupancy summed, numeric gauges summed,
+        per-replica rows namespaced ``<snapshot-name>/<replica>``, and
+        latency / queue-age quantiles recomputed from the merged raw
+        sketches (take the inputs with ``snapshot(sketches=True)``).
+        Phase/span tables fold per key (seconds and calls summed).
+
+        A snapshot without a sketch still contributes its counters and
+        occupancy; its latency reservoir simply cannot participate in
+        the merged quantiles (the merged ``count`` reflects only
+        sketch-bearing inputs — exact over what was shipped, never a
+        made-up percentile). This is what the cluster router's periodic
+        INFO line and ``snapshot()`` report: fleet-wide shed / queue-age
+        / occupancy, not per-process shards."""
+        counters: Dict[str, int] = defaultdict(int)
+        gauges: Dict[str, float] = defaultdict(float)
+        items = capacity = 0
+        replicas: Dict[str, object] = {}
+        lats: list = []
+        ages: list = []
+        phases: Dict[str, Dict[str, float]] = {}
+        spans: Dict[str, Dict[str, float]] = {}
+
+        def _fold_table(dst, src):
+            for key, row in (src or {}).items():
+                if not isinstance(row, dict):
+                    continue
+                slot = dst.setdefault(key, defaultdict(float))
+                for k, v in row.items():
+                    if isinstance(v, (int, float)):
+                        slot[k] += v
+
+        for i, snap in enumerate(snapshots):
+            if not snap:
+                continue
+            label = str(snap.get("name") or i)
+            for k, v in (snap.get("counters") or {}).items():
+                counters[k] += int(v)
+            for k, v in (snap.get("gauges") or {}).items():
+                if isinstance(v, (int, float)):
+                    gauges[k] += v
+            occ = snap.get("batch_occupancy") or {}
+            items += int(occ.get("items") or 0)
+            capacity += int(occ.get("capacity") or 0)
+            for idx, row in (snap.get("replicas") or {}).items():
+                replicas[f"{label}/{idx}"] = dict(row)
+            sketch = snap.get("sketch") or {}
+            lats.extend(sketch.get("latencies") or [])
+            ages.extend(sketch.get("queue_ages") or [])
+            _fold_table(phases, snap.get("phases"))
+            _fold_table(spans, snap.get("spans"))
+        return {
+            "name": name,
+            "merged_from": len(list(snapshots)),
+            "counters": dict(counters),
+            "gauges": dict(gauges),
+            "batch_occupancy": {
+                "items": items,
+                "capacity": capacity,
+                "ratio": (items / capacity) if capacity else None,
+            },
+            "replicas": replicas,
+            "latency": MetricsRegistry._quantiles(sorted(lats)),
+            "queue_age": MetricsRegistry._quantiles(sorted(ages)),
+            "phases": {k: dict(v) for k, v in phases.items()},
+            "spans": {k: dict(v) for k, v in spans.items()},
         }
 
     @staticmethod

@@ -86,6 +86,44 @@ logger = logging.getLogger(__name__)
 _SERVICE_ALPHA = 0.3
 
 
+class ServiceEstimate:
+    """The learned batch-service-time EWMA and its admission pricing —
+    the ONE deadline-shedding discipline, shared by every admission
+    surface: the in-process :class:`FleetScheduler` below and the
+    cluster router (:mod:`keystone_tpu.cluster.router`), which prices
+    front-door shedding from aggregate queue depth ÷ fleet capacity with
+    exactly this object. Not thread-safe on its own; callers fold
+    observations under their admission lock (a torn float read on the
+    lock-free paths is harmless — the EWMA converges regardless)."""
+
+    def __init__(self, alpha: float = _SERVICE_ALPHA):
+        self._alpha = alpha
+        self._ewma: Optional[float] = None
+
+    @property
+    def estimate(self) -> Optional[float]:
+        """Learned seconds per micro-batch, None before any evidence."""
+        return self._ewma
+
+    def observe(self, seconds: float) -> None:
+        prev = self._ewma
+        self._ewma = (
+            seconds if prev is None
+            else prev + self._alpha * (seconds - prev)
+        )
+
+    def wait(self, depth: int, capacity: int) -> float:
+        """Deterministic completion estimate for a request admitted NOW:
+        its own batch's service time plus the whole batches already
+        queued ahead of it (``depth`` requests over ``capacity`` rows of
+        concurrent batch capacity). Zero before any evidence — a cold
+        admission surface must not shed traffic it cannot price."""
+        s = self._ewma
+        if s is None:
+            return 0.0
+        return s * (1 + depth // max(int(capacity), 1))
+
+
 class FleetScheduler:
     """Shared admission queue + per-replica run queues for N replicas."""
 
@@ -119,7 +157,7 @@ class FleetScheduler:
         self._in_flight = 0  # batches handed to replicas, not yet done
         self._closed = False  # no further admission
         self._stop = False  # workers should exit
-        self._service_ewma: Optional[float] = None
+        self._service = ServiceEstimate()
 
     # -- introspection ---------------------------------------------------
 
@@ -131,7 +169,7 @@ class FleetScheduler:
     @property
     def service_estimate(self) -> Optional[float]:
         """Learned seconds per micro-batch (EWMA), None before evidence."""
-        return self._service_ewma
+        return self._service.estimate
 
     def queue_depths(self) -> List[int]:
         with self._lock:
@@ -142,22 +180,12 @@ class FleetScheduler:
     def observe_service(self, seconds: float) -> None:
         """Fold one measured batch execution into the service EWMA (also
         the seam tests and benches use to seed a known estimate)."""
-        prev = self._service_ewma
-        self._service_ewma = (
-            seconds if prev is None
-            else prev + _SERVICE_ALPHA * (seconds - prev)
-        )
+        self._service.observe(seconds)
 
     def estimated_wait(self) -> float:
-        """Deterministic completion estimate for a request admitted NOW:
-        its own batch's service time plus the whole batches already
-        queued ahead of it across the fleet. Zero before any evidence —
-        a cold scheduler must not shed traffic it cannot price."""
-        s = self._service_ewma
-        if s is None:
-            return 0.0
-        capacity = self._n * self._policy.max_size
-        return s * (1 + self._depth // capacity)
+        """Deterministic completion estimate for a request admitted NOW
+        (see :meth:`ServiceEstimate.wait`) across the fleet's capacity."""
+        return self._service.wait(self._depth, self._n * self._policy.max_size)
 
     # -- admission -------------------------------------------------------
 
@@ -266,7 +294,7 @@ class FleetScheduler:
             wait_budget = gather_until - now
             # the service estimate is how long the batch will take once
             # dispatched; waiting may only consume slack beyond that
-            exec_s = self._service_ewma or 0.0
+            exec_s = self._service.estimate or 0.0
             for r in batch:
                 if r.deadline is not None:
                     wait_budget = min(

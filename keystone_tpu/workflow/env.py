@@ -17,11 +17,56 @@ if TYPE_CHECKING:  # pragma: no cover
     from .optimizers import Optimizer
 
 
+class VersionedState(Dict[Prefix, Expression]):
+    """The prefix → saved-expression table, with a mutation counter.
+
+    The optimizer memo (:mod:`~keystone_tpu.workflow.optimizers`) keys
+    cached rule-stack results on this version: ``SavedStateLoadRule``
+    bakes state values INTO optimized graphs, so any mutation here —
+    a fit saving a prefix, a test clearing the table — must invalidate
+    every memoized plan rather than serve a stale load."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.version = 0
+
+    def _bump(self) -> None:
+        self.version += 1
+
+    def __setitem__(self, key, value) -> None:
+        self._bump()
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key) -> None:
+        self._bump()
+        super().__delitem__(key)
+
+    def clear(self) -> None:
+        self._bump()
+        super().clear()
+
+    def pop(self, *args):
+        self._bump()
+        return super().pop(*args)
+
+    def popitem(self):
+        self._bump()
+        return super().popitem()
+
+    def setdefault(self, key, default=None):
+        self._bump()
+        return super().setdefault(key, default)
+
+    def update(self, *args, **kwargs) -> None:
+        self._bump()
+        super().update(*args, **kwargs)
+
+
 class PipelineEnv:
     _instance: Optional["PipelineEnv"] = None
 
     def __init__(self) -> None:
-        self.state: Dict[Prefix, Expression] = {}
+        self.state: VersionedState = VersionedState()
         self._optimizer: Optional["Optimizer"] = None
 
     @classmethod

@@ -117,6 +117,14 @@ class GraphExecutor:
         self._build_lock = threading.Lock()
 
     @property
+    def input_graph(self) -> Graph:
+        """The graph as handed in, WITHOUT forcing the lazy optimize —
+        the composition seam (``attach_data`` splices this, so building
+        an L-stage pipeline never runs the rule stack; ``fit``/``get``
+        optimize the composed graph exactly once)."""
+        return self._input_graph
+
+    @property
     def graph(self) -> Graph:
         """The optimized graph (optimization happens once, lazily)."""
         if self._optimized is None:

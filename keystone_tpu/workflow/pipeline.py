@@ -77,6 +77,32 @@ class PipelineResult:
         return self._executor.graph
 
     @property
+    def spliced_graph(self) -> Graph:
+        """The graph composition splices: CSE-canonicalized, NOT fully
+        optimized. Reading :attr:`graph` instead would force the
+        executor's lazy optimize — the full rule stack (saved-state
+        loads, node-implementation sampling, autocache planning, trace
+        fusion) re-run on the prefix subgraph at every ``and_then``
+        step. Only the structural merge is load-bearing for composition:
+        the serving-path and estimator-data copies of a prefix both root
+        at the same data leaf here, and merging them is what keeps an
+        L-stage chain's graph linear instead of 2^L. Everything else
+        waits for the one ``fit``/``get`` pass over the composed graph.
+        A result that already paid its full optimize splices that
+        (strictly more canonical, ids stable)."""
+        if self._executor._optimized is not None:
+            return self._executor._optimized
+        cached = getattr(self._executor, "_cse_graph", None)
+        if cached is None:
+            from .rules import EquivalentNodeMergeRule
+
+            cached, _ = EquivalentNodeMergeRule().apply(
+                self._executor.input_graph, {}
+            )
+            self._executor._cse_graph = cached
+        return cached
+
+    @property
     def sink(self) -> SinkId:
         return self._sink
 
@@ -206,7 +232,12 @@ def attach_data(graph: Graph, data: Any) -> tuple:
     Returns ``(graph, dep_id)``.
     """
     if isinstance(data, PipelineResult):
-        other = data.graph
+        # splice the CSE-canonicalized (not fully optimized) graph:
+        # forcing data.graph here would run the full optimizer stack on
+        # the prefix subgraph at every composition step (L rule-stack
+        # runs for an L-stage and_then chain) — the composed pipeline's
+        # own fit/get optimizes once; see PipelineResult.spliced_graph
+        other = data.spliced_graph
         merged, _, sink_map = graph.add_graph(other)
         dep = merged.get_sink_dependency(sink_map[data.sink])
         # drop the imported sinks; keep everything else

@@ -1,0 +1,122 @@
+"""Wire protocol unit tests: framing, deadline transport, typed errors."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.cluster.wire import (
+    ConnectionClosed,
+    WorkerError,
+    deadline_from_wire,
+    deadline_to_wire,
+    decode_error,
+    encode_error,
+    recv_msg,
+    send_msg,
+)
+from keystone_tpu.serving.errors import (
+    DeadlineExceeded,
+    EngineStopped,
+    QueueFull,
+    Shed,
+)
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_frame_round_trip_with_arrays():
+    a, b = _pair()
+    try:
+        msg = {
+            "type": "req", "id": 7,
+            "datum": np.arange(12, dtype=np.float32).reshape(3, 4),
+        }
+        send_msg(a, msg)
+        got = recv_msg(b)
+        assert got["type"] == "req" and got["id"] == 7
+        np.testing.assert_array_equal(got["datum"], msg["datum"])
+    finally:
+        a.close()
+        b.close()
+
+
+def test_interleaved_frames_stay_ordered():
+    a, b = _pair()
+    try:
+        lock = threading.Lock()
+
+        def sender(lo, hi):
+            for i in range(lo, hi):
+                with lock:
+                    send_msg(a, {"i": i})
+
+        ts = [
+            threading.Thread(target=sender, args=(k * 50, k * 50 + 50))
+            for k in range(2)
+        ]
+        for t in ts:
+            t.start()
+        seen = sorted(recv_msg(b)["i"] for _ in range(100))
+        for t in ts:
+            t.join()
+        assert seen == list(range(100))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_eof_raises_connection_closed():
+    a, b = _pair()
+    a.close()
+    with pytest.raises(ConnectionClosed):
+        recv_msg(b)
+    b.close()
+
+
+def test_mid_frame_eof_raises_connection_closed():
+    a, b = _pair()
+    # a length prefix promising more bytes than ever arrive
+    a.sendall(b"\x00\x00\x01\x00partial")
+    a.close()
+    with pytest.raises(ConnectionClosed, match="mid-frame"):
+        recv_msg(b)
+    b.close()
+
+
+def test_deadline_travels_as_remaining_budget():
+    deadline = time.monotonic() + 5.0
+    rem = deadline_to_wire(deadline)
+    assert 4.9 < rem <= 5.0
+    rebuilt = deadline_from_wire(rem)
+    # re-anchored on (this) clock: remaining budget is preserved, the
+    # hop can only shrink it, never extend it
+    assert rebuilt - time.monotonic() <= 5.0
+    assert deadline_to_wire(None) is None
+    assert deadline_from_wire(None) is None
+    # an expired deadline stays expired (clamped, no wrap)
+    assert deadline_to_wire(time.monotonic() - 10.0) == 0.0
+
+
+@pytest.mark.parametrize(
+    "exc", [Shed("late"), DeadlineExceeded("x"), QueueFull("full"),
+            EngineStopped("bye")],
+)
+def test_typed_errors_round_trip(exc):
+    back = decode_error(encode_error(exc))
+    assert type(back) is type(exc)
+    assert str(exc) in str(back)
+
+
+def test_unknown_error_degrades_to_worker_error():
+    class Weird(Exception):
+        pass
+
+    back = decode_error(encode_error(Weird("odd")))
+    assert isinstance(back, WorkerError)
+    assert "Weird" in str(back)

@@ -18,15 +18,18 @@ def pipeline_env():
     """Reset global pipeline state around every test (parity:
     PipelineContext.afterEach resetting PipelineEnv)."""
     from keystone_tpu.workflow.env import PipelineEnv
+    from keystone_tpu.workflow.optimizers import clear_memo
 
     import keystone_tpu.cost as cost
     import keystone_tpu.faults as faults
 
     env = PipelineEnv.get_or_create()
     env.reset()
+    clear_memo()  # memoized plans pin operator objects; start each test cold
     cost.reset()  # profile store is env-var-memoized like the AOT cache
     faults.clear()  # no fault plan (or stale invocation counters) leaks
     yield env
     env.reset()
+    clear_memo()
     cost.reset()
     faults.clear()

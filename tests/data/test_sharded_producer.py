@@ -1,0 +1,177 @@
+"""Sharded chunk production (ISSUE 12): N producer shards partition the
+chunk index space, and the merged stream is bit-identical to the single
+producer's — same chunks, same order, same values."""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.shards import ShardedChunkProducer, maybe_shard
+
+
+def _chunk_fn(i, rows=8, d=4):
+    rng = np.random.RandomState(1000 + i)
+    return rng.randn(rows, d).astype(np.float32)
+
+
+def _dataset(n_chunks=12, rows=8, d=4):
+    return ChunkedDataset.from_chunk_fn(
+        lambda i: _chunk_fn(i, rows, d), n_chunks, n_chunks * rows,
+        label="shardtest",
+    )
+
+
+def _digest(chunks):
+    h = hashlib.sha256()
+    for c in chunks:
+        h.update(np.ascontiguousarray(np.asarray(c)).tobytes())
+    return h.hexdigest()
+
+
+def test_stream_bit_identical_across_shard_counts(monkeypatch):
+    ds = _dataset()
+    monkeypatch.delenv("KEYSTONE_SCAN_SHARDS", raising=False)
+    base = _digest(ds.raw_chunks())
+    for shards in (2, 3, 5):
+        monkeypatch.setenv("KEYSTONE_SCAN_SHARDS", str(shards))
+        assert _digest(ds.raw_chunks()) == base, f"shards={shards}"
+        # the pipelined front door too
+        assert _digest(ds.chunks()) == base, f"chunks() shards={shards}"
+
+
+def test_sharded_production_through_map_chain(monkeypatch):
+    ds = _dataset().map_batch(lambda c: c * 2.0 + 1.0)
+    monkeypatch.delenv("KEYSTONE_SCAN_SHARDS", raising=False)
+    base = _digest(ds.raw_chunks())
+    monkeypatch.setenv("KEYSTONE_SCAN_SHARDS", "3")
+    assert _digest(ds.raw_chunks()) == base
+
+
+def test_shard_counts_partition_index_space():
+    prod = ShardedChunkProducer(
+        lambda start, step: iter(
+            _chunk_fn(i) for i in range(start, 10, step)
+        ),
+        3,
+        label="t",
+    )
+    got = list(prod)
+    assert len(got) == 10
+    # shard s produced indices s, s+3, ... — 4/3/3 of 10
+    assert sorted(prod.shard_chunks, reverse=True) == [4, 3, 3]
+
+
+def test_skip_and_shards_compose(monkeypatch):
+    ds = _dataset()
+    expect = _digest(list(ds.raw_chunks())[4:])
+    monkeypatch.setenv("KEYSTONE_SCAN_SHARDS", "2")
+    assert _digest(ds.raw_chunks(skip=4)) == expect
+
+
+def test_shard_error_surfaces_at_its_index():
+    def fn(i):
+        if i == 5:
+            raise RuntimeError("boom at 5")
+        return _chunk_fn(i)
+
+    ds = ChunkedDataset.from_chunk_fn(fn, 8, 64, label="errtest")
+    it = maybe_shard(
+        ds._skip_factory, lambda: iter(ds._payload()), shards=3,
+        label="errtest",
+    )
+    got = []
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        for c in it:
+            got.append(c)
+    # every chunk BEFORE the failing index was delivered, in order
+    assert len(got) == 5
+    assert _digest(got) == _digest(_chunk_fn(i) for i in range(5))
+
+
+def test_early_close_joins_shard_threads():
+    before = {t.name for t in threading.enumerate()}
+    prod = ShardedChunkProducer(
+        lambda start, step: iter(
+            _chunk_fn(i) for i in range(start, 100, step)
+        ),
+        4,
+        label="close",
+    )
+    next(prod)
+    prod.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        leaked = [
+            t
+            for t in threading.enumerate()
+            if t.name.startswith("ks-shard[close]") and t.is_alive()
+        ]
+        if not leaked:
+            break
+        time.sleep(0.02)
+    assert not leaked, f"shard threads leaked: {leaked}"
+    assert {t.name for t in threading.enumerate()} - before <= set()
+
+
+def test_opaque_factory_falls_back_to_single_producer(monkeypatch):
+    # a plain generator factory has no stride seam: sharding must
+    # degrade to the single producer, never fail
+    def factory():
+        for i in range(6):
+            yield _chunk_fn(i)
+
+    ds = ChunkedDataset(factory, 48, label="opaque")
+    monkeypatch.setenv("KEYSTONE_SCAN_SHARDS", "4")
+    got = list(ds.raw_chunks())
+    assert _digest(got) == _digest(_chunk_fn(i) for i in range(6))
+
+
+def test_fit_parity_streaming_solver_at_2_shards(monkeypatch):
+    from keystone_tpu.linalg.normal_equations import (
+        solve_least_squares_streaming,
+    )
+
+    n_chunks, rows, d, k = 8, 16, 6, 3
+    rng = np.random.RandomState(7)
+    W = rng.randn(d, k).astype(np.float32)
+
+    def xy(i):
+        X = _chunk_fn(i, rows, d)
+        return X, X @ W
+
+    ds = ChunkedDataset.from_chunk_fn(xy, n_chunks, n_chunks * rows)
+    monkeypatch.delenv("KEYSTONE_SCAN_SHARDS", raising=False)
+    w1 = np.asarray(
+        solve_least_squares_streaming(ds.raw_chunks(), reg=1e-3, lanes=1)
+    )
+    monkeypatch.setenv("KEYSTONE_SCAN_SHARDS", "2")
+    w2 = np.asarray(
+        solve_least_squares_streaming(ds.raw_chunks(), reg=1e-3, lanes=1)
+    )
+    np.testing.assert_allclose(w1, w2, atol=1e-6, rtol=1e-6)
+
+
+def test_scan_span_carries_shard_attrs(monkeypatch):
+    from keystone_tpu.obs import tracer as trace_mod
+
+    monkeypatch.setenv("KEYSTONE_SCAN_SHARDS", "3")
+    tracer = trace_mod.Tracer()
+    installed = trace_mod.install_if_absent(tracer)
+    try:
+        ds = _dataset(n_chunks=9)
+        list(ds.chunks())
+        spans = [
+            s for s in tracer.spans() if s.name == "scan.pipeline"
+            and s.attrs.get("label") == "shardtest"
+        ]
+        assert spans, "no scan.pipeline span recorded"
+        sp = spans[-1]
+        assert sp.attrs["shards"] == 3
+        assert sum(sp.attrs["shard_chunks"]) == 9
+    finally:
+        if installed is not None:
+            trace_mod.uninstall(tracer)

@@ -1,0 +1,86 @@
+"""MetricsRegistry.merge: worker-process snapshots fold into one
+fleet-wide view (ISSUE 12 satellite — the cluster router's merged
+report)."""
+
+import numpy as np
+
+from keystone_tpu.serving.metrics import MetricsRegistry
+
+
+def _worker(name, n, base_latency):
+    m = MetricsRegistry(name=name)
+    m.inc("submitted", n)
+    m.inc("completed", n)
+    m.inc("shed", 2)
+    for i in range(n):
+        m.observe_latency(base_latency + i * 0.001)
+        m.observe_queue_age(base_latency / 2 + i * 0.0005)
+    m.observe_batch(6, 8, replica=0)
+    m.observe_batch(8, 8, replica=1)
+    m.set_gauge("queue_depth", lambda: 3.0)
+    return m
+
+
+def test_counters_sum_and_replicas_namespace():
+    a = _worker("w0", 10, 0.010)
+    b = _worker("w1", 20, 0.100)
+    merged = MetricsRegistry.merge(
+        [a.snapshot(sketches=True), b.snapshot(sketches=True)]
+    )
+    assert merged["counters"]["submitted"] == 30
+    assert merged["counters"]["shed"] == 4
+    assert merged["gauges"]["queue_depth"] == 6.0
+    # per-replica rows survive, namespaced by worker name
+    assert set(merged["replicas"]) == {"w0/0", "w0/1", "w1/0", "w1/1"}
+    occ = merged["batch_occupancy"]
+    assert occ["items"] == 28 and occ["capacity"] == 32
+    assert abs(occ["ratio"] - 28 / 32) < 1e-9
+
+
+def test_quantiles_recomputed_from_merged_sketches():
+    a = _worker("w0", 50, 0.010)
+    b = _worker("w1", 50, 0.100)
+    merged = MetricsRegistry.merge(
+        [a.snapshot(sketches=True), b.snapshot(sketches=True)]
+    )
+    lat = merged["latency"]
+    assert lat["count"] == 100
+    # exact nearest-rank over the union — NOT an average of per-worker
+    # p99s: the merged p99 must come from the slow worker's tail
+    union = sorted(
+        [0.010 + i * 0.001 for i in range(50)]
+        + [0.100 + i * 0.001 for i in range(50)]
+    )
+    assert abs(lat["p99"] - union[98]) < 1e-12
+    assert abs(lat["p50"] - union[49]) < 1e-12
+    assert merged["queue_age"]["count"] == 100
+
+
+def test_snapshot_without_sketch_still_contributes_counters():
+    a = _worker("w0", 10, 0.010)
+    b = _worker("w1", 10, 0.020)
+    merged = MetricsRegistry.merge(
+        [a.snapshot(sketches=True), b.snapshot()]  # b ships no sketch
+    )
+    assert merged["counters"]["submitted"] == 20
+    # only the sketch-bearing worker participates in quantiles
+    assert merged["latency"]["count"] == 10
+
+
+def test_merge_of_empty_inputs_is_well_formed():
+    merged = MetricsRegistry.merge([])
+    assert merged["counters"] == {}
+    assert merged["latency"] == {"count": 0}
+    assert merged["batch_occupancy"]["ratio"] is None
+    merged2 = MetricsRegistry.merge([{}, None])
+    assert merged2["counters"] == {}
+
+
+def test_sketch_is_bounded_by_reservoir_window():
+    m = MetricsRegistry(name="w", latency_window=16)
+    for i in range(100):
+        m.observe_latency(float(i))
+    snap = m.snapshot(sketches=True)
+    assert len(snap["sketch"]["latencies"]) == 16
+    # default snapshot carries no sketch (nothing extra over the wire)
+    assert "sketch" not in m.snapshot()

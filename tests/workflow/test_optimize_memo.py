@@ -1,0 +1,133 @@
+"""Construction-time prefix optimization is memoized (ISSUE 12 satellite).
+
+Before this change, ``and_then(estimator, data)`` spliced the LAZY
+result's graph via ``PipelineResult.graph``, which forced the executor's
+optimize — re-running the full rule stack on the growing prefix subgraph
+at every composition step (L runs for an L-stage chain). Composition now
+splices the raw graph (zero rule-stack runs until fit/get), and
+``Optimizer.execute`` memoizes by graph fingerprint + operator identity
+so repeated optimizations of the same graph (re-applied pipelines,
+rebuilt sweeps) run the stack once.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow import optimizers as opt_mod
+from keystone_tpu.workflow.env import PipelineEnv
+from keystone_tpu.workflow.rules import RuleExecutor
+from keystone_tpu.workflow.transformer import Estimator, FunctionNode
+
+
+class _CenterEstimator(Estimator):
+    def fit(self, data):
+        m = np.mean(np.asarray(data.to_array()))
+        return FunctionNode(batch_fn=lambda X, m=m: X - m, label="center")
+
+
+@pytest.fixture
+def rule_stack_runs(monkeypatch):
+    """Count REAL rule-stack executions (memo hits don't reach this)."""
+    calls = []
+    orig = RuleExecutor.execute
+
+    def spy(self, graph, annotations=None):
+        calls.append(len(graph.nodes))
+        return orig(self, graph, annotations)
+
+    monkeypatch.setattr(RuleExecutor, "execute", spy)
+    return calls
+
+
+def _chain(X, stages=4):
+    p = FunctionNode(batch_fn=lambda X: X * 2.0, label="f0").to_pipeline()
+    for _ in range(stages):
+        p = p.and_then(_CenterEstimator(), X)
+    return p
+
+
+def test_composition_runs_zero_rule_stacks(rule_stack_runs):
+    X = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+    _chain(X, stages=4)
+    assert rule_stack_runs == [], (
+        "and_then composition must not run the optimizer; "
+        f"saw runs over graphs of sizes {rule_stack_runs}"
+    )
+
+
+def test_fit_optimizes_once_and_matches_eager_semantics(rule_stack_runs):
+    X = np.random.RandomState(1).randn(16, 3).astype(np.float32)
+    p = _chain(X, stages=3)
+    fitted = p.fit()
+    # fit runs the stack: once for the pipeline graph itself, plus the
+    # estimator-data pulls inside fit run optimize=False (not counted)
+    assert len(rule_stack_runs) == 1
+    out = np.asarray(fitted.apply(X).to_array())
+    # 3x centering after doubling: centered data has zero mean each step
+    expect = X * 2.0
+    for _ in range(3):
+        expect = expect - expect.mean()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_repeated_optimize_of_same_graph_hits_memo(rule_stack_runs):
+    X = np.random.RandomState(2).randn(8, 3).astype(np.float32)
+    p = (
+        FunctionNode(batch_fn=lambda X: X + 1.0, label="g0")
+        .to_pipeline()
+        .and_then(FunctionNode(batch_fn=lambda X: X * 3.0, label="g1"))
+    )
+    a = np.asarray(p.apply(X).get().to_array())
+    runs_after_first = len(rule_stack_runs)
+    assert runs_after_first >= 1
+    b = np.asarray(p.apply(X).get().to_array())
+    assert len(rule_stack_runs) == runs_after_first, (
+        "second apply of the same pipeline over the same data must be "
+        "a memo hit"
+    )
+    np.testing.assert_array_equal(a, b)
+    assert opt_mod.memo_stats["hits"] >= 1
+
+
+def test_state_mutation_invalidates_memo(rule_stack_runs):
+    X = np.random.RandomState(3).randn(8, 3).astype(np.float32)
+    p = (
+        FunctionNode(batch_fn=lambda X: X - 1.0, label="h0")
+        .to_pipeline()
+        .and_then(FunctionNode(batch_fn=lambda X: X * 0.5, label="h1"))
+    )
+    p.apply(X).get()
+    runs = len(rule_stack_runs)
+    # a saved-state mutation (fit persisting a prefix, a test reset)
+    # must invalidate the plan: SavedStateLoadRule bakes state into it
+    PipelineEnv.get_or_create().state.clear()
+    p.apply(X).get()
+    assert len(rule_stack_runs) == runs + 1
+
+
+def test_distinct_estimator_instances_do_not_share_plans(rule_stack_runs):
+    X = np.random.RandomState(4).randn(8, 3).astype(np.float32)
+    head = FunctionNode(batch_fn=lambda X: X * 2.0, label="k0").to_pipeline()
+    a = head.and_then(_CenterEstimator(), X)
+    b = head.and_then(_CenterEstimator(), X)
+    fa, fb = a.fit(), b.fit()
+    # both must fit their OWN estimator instance (identity-keyed plans)
+    np.testing.assert_allclose(
+        np.asarray(fa.apply(X).to_array()),
+        np.asarray(fb.apply(X).to_array()),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_memo_kill_switch(rule_stack_runs, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_OPT_MEMO", "0")
+    X = np.random.RandomState(5).randn(8, 3).astype(np.float32)
+    p = (
+        FunctionNode(batch_fn=lambda X: X + 2.0, label="m0")
+        .to_pipeline()
+        .and_then(FunctionNode(batch_fn=lambda X: X * 2.0, label="m1"))
+    )
+    p.apply(X).get()
+    runs = len(rule_stack_runs)
+    p.apply(X).get()
+    assert len(rule_stack_runs) == runs + 1
