@@ -404,3 +404,38 @@ print(
     f"{served} request(s) served across the swap with zero failures"
 )
 PY
+
+# Stage 8: static --check mode (keystone_tpu/check/). Running mnist with
+# --check must emit a non-empty `check.report` span whose segment plan
+# has >= 2 traceable segments, with ZERO sampled executions recorded on
+# the span (the checker proves its facts without running anything), and
+# must exit 0 without producing a single chunk.
+out8="$(mktemp /tmp/keystone-check-XXXXXX.json)"
+env JAX_PLATFORMS=cpu python -m keystone_tpu mnist --backend cpu \
+  --numFFTs 2 --blockSize 512 --lambda 100 --check --trace "$out8" \
+  | grep -q "CHECK OK" || { echo "check mode did not report CHECK OK"; exit 1; }
+python - "$out8" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    doc = json.load(f)
+ev = doc["traceEvents"]
+reports = [e for e in ev if e["name"] == "check.report"]
+assert reports, "no check.report span"
+args = reports[-1].get("args", {})
+assert int(args["segments"]) >= 2, args
+assert int(args["nodes"]) > 0, args
+assert int(args["sampling_total"]) == 0, (
+    f"static check sampled: {args}"
+)
+# --check executes nothing: no scan, no node pulls, no fit root
+for forbidden in ("pipeline.fit", "scan.pipeline", "node.feat"):
+    assert not any(e["name"] == forbidden for e in ev), (
+        f"{forbidden} span present in a --check run"
+    )
+print(
+    f"CHECK SPAN OK: {args['nodes']} nodes, {args['segments']} segments, "
+    f"sampling_total=0, no execution spans"
+)
+PY

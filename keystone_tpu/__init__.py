@@ -22,9 +22,11 @@ def _enable_persistent_compile_cache() -> None:
     ``KEYSTONE_NO_COMPILE_CACHE=1`` to disable, ``KEYSTONE_COMPILE_CACHE=dir``
     to relocate). Compiles dominate cold-start wall time on TPU; caching them
     across processes is free speed for every pipeline."""
-    if _os.environ.get("KEYSTONE_NO_COMPILE_CACHE"):
+    from .utils import env_flag, env_str
+
+    if env_flag("KEYSTONE_NO_COMPILE_CACHE", False):
         return
-    chosen = _os.environ.get("KEYSTONE_COMPILE_CACHE")
+    chosen = env_str("KEYSTONE_COMPILE_CACHE")
     cache_dir = chosen or _os.path.join(
         _os.path.expanduser("~"), ".cache", "keystone_tpu", "xla"
     )
@@ -35,7 +37,7 @@ def _enable_persistent_compile_cache() -> None:
     # --backend flag to pick a backend programmatically.
     import jax
 
-    if _os.environ.get("JAX_COMPILATION_CACHE_DIR") or getattr(
+    if env_str("JAX_COMPILATION_CACHE_DIR") or getattr(
         jax.config, "jax_compilation_cache_dir", None
     ):
         return  # the user already configured a cache; don't hijack it
@@ -47,7 +49,11 @@ def _enable_persistent_compile_cache() -> None:
             global _default_xla_cache_dir
             _default_xla_cache_dir = cache_dir
     except Exception:  # pragma: no cover - jax without these specific knobs
-        pass
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "persistent compile cache not enabled", exc_info=True
+        )
 
 
 _enable_persistent_compile_cache()

@@ -208,6 +208,14 @@ def main(argv: Optional[List[str]] = None) -> int:
              "(also: KEYSTONE_PROFILE=1)",
     )
     p.add_argument(
+        "--check", action="store_true", dest="check_only",
+        help="static-check mode: build the pipeline, run the whole-DAG "
+             "shape/dtype/traceability checker and segment planner "
+             "(keystone_tpu/check/) at fit entry, print the report, and "
+             "exit WITHOUT executing a single chunk or sample; non-zero "
+             "exit on a statically-proven defect",
+    )
+    p.add_argument(
         "--trace", default=None, metavar="PATH",
         help="record a per-node execution trace and write Chrome-trace "
              "JSON to PATH — open in chrome://tracing or "
@@ -238,17 +246,39 @@ def main(argv: Optional[List[str]] = None) -> int:
         aot_cache=args.aot_cache, profiles=args.profiles,
     )
     _select_backend(args.backend, args.cpuDevices)
+    if args.check_only:
+        from . import check as check_mod
+
+        check_mod.set_check_only(True)
     try:
-        if serve_demo:
-            from .serving.demo import main as serve_demo_main
+        try:
+            if serve_demo:
+                from .serving.demo import main as serve_demo_main
 
-            return serve_demo_main(rest)
-        if sweep_demo:
-            from .sweep.demo import main as sweep_demo_main
+                return serve_demo_main(rest)
+            if sweep_demo:
+                from .sweep.demo import main as sweep_demo_main
 
-            return sweep_demo_main(rest)
-        return PIPELINES[name](rest)
+                return sweep_demo_main(rest)
+            return PIPELINES[name](rest)
+        except Exception as e:
+            from . import check as check_mod
+
+            if args.check_only and isinstance(e, check_mod.CheckOnlyExit):
+                s = e.report.summary()
+                print(
+                    f"CHECK OK: {s['nodes']} nodes, {s['segments']} "
+                    f"segment(s), {s['barriers']} barrier(s), "
+                    f"0 executions"
+                )
+                return 0
+            raise
     finally:
+        if args.check_only:
+            from . import check as check_mod
+
+            # in-process callers (tests) must not leak check-only mode
+            check_mod.set_check_only(False)
         # no-op unless --trace/KEYSTONE_TRACE configured tracing; writing
         # here (not only atexit) means in-process callers get the file too
         export_trace()

@@ -1,0 +1,108 @@
+"""Static pipeline checker: whole-DAG shape/dtype/traceability inference
+and compilation-segment planning, before anything executes.
+
+Three layers (see the module docstrings):
+
+* :mod:`~keystone_tpu.check.abstract` — an abstract interpreter
+  propagating ``jax.ShapeDtypeStruct`` specs from data leaves through
+  every node via ``jax.eval_shape`` (with an ``out_spec`` declaration
+  protocol for nodes whose apply is not abstractly evaluable), so
+  shape/dtype/rank mismatches raise a typed, node-attributed
+  :class:`PipelineCheckError` at ``and_then``/``fit()`` entry instead of
+  mid-scan;
+* :mod:`~keystone_tpu.check.lattice` — a traceability lattice
+  (``traceable | host_callback | batch_coupled | stateful | opaque``)
+  classifying every node from static evidence, which the dynamic
+  compile/export paths assert against;
+* :mod:`~keystone_tpu.check.segments` — a segment planner partitioning
+  the DAG into maximal traceable segments between materialization
+  barriers: the future whole-DAG compilation-unit plan.
+
+Front doors: ``Pipeline.check()`` / ``FittedPipeline.check()``, the
+``--check`` CLI mode, and :func:`check_graph` for raw graphs. The whole
+check runs in milliseconds with ZERO chunk executions and ZERO sampled
+executions (``cost.count_sampling`` stays untouched — smoke-asserted).
+
+``KEYSTONE_STATIC_CHECK=0`` disables the implicit fit-entry/and_then
+checks (explicit ``check()`` calls always run).
+"""
+
+from __future__ import annotations
+
+from .abstract import (
+    SYMBOLIC_LEAD,
+    EstimatorSpec,
+    Spec,
+    SpecTuple,
+    infer_specs,
+    spec_from_item,
+    spec_of_array,
+)
+from .errors import CheckOnlyExit, ContractMismatchError, PipelineCheckError
+from .lattice import (
+    BATCH_COUPLED,
+    HOST_CALLBACK,
+    OPAQUE,
+    STATEFUL,
+    TRACEABLE,
+    blocks_export,
+    blocks_jit,
+    classify,
+    register_verdict,
+)
+from .report import CheckReport, check_graph
+from .segments import Segment, plan_segments
+
+__all__ = [
+    "BATCH_COUPLED",
+    "CheckOnlyExit",
+    "CheckReport",
+    "ContractMismatchError",
+    "EstimatorSpec",
+    "HOST_CALLBACK",
+    "OPAQUE",
+    "PipelineCheckError",
+    "STATEFUL",
+    "SYMBOLIC_LEAD",
+    "Segment",
+    "Spec",
+    "SpecTuple",
+    "TRACEABLE",
+    "blocks_export",
+    "blocks_jit",
+    "check_enabled",
+    "check_graph",
+    "check_only_mode",
+    "classify",
+    "infer_specs",
+    "plan_segments",
+    "register_verdict",
+    "set_check_only",
+    "spec_from_item",
+    "spec_of_array",
+]
+
+
+def check_enabled() -> bool:
+    """Are the implicit construction/fit-entry checks on?
+    (``KEYSTONE_STATIC_CHECK=0`` is the kill switch.)"""
+    from ..utils import env_flag
+
+    return env_flag("KEYSTONE_STATIC_CHECK", True)
+
+
+# -- --check CLI mode -------------------------------------------------------
+
+_check_only = False
+
+
+def set_check_only(on: bool) -> None:
+    """Arm/disarm check-only mode: the next ``Pipeline.fit()`` runs the
+    static check, prints the report, and raises :class:`CheckOnlyExit`
+    instead of fitting (the ``--check`` CLI flag)."""
+    global _check_only
+    _check_only = bool(on)
+
+
+def check_only_mode() -> bool:
+    return _check_only

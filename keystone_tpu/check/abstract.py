@@ -1,0 +1,500 @@
+"""Abstract interpretation of a pipeline graph: shape/dtype propagation
+with ZERO executions.
+
+Specs originate at data leaves (materialized arrays report their shape;
+chunked sources report the per-item spec recorded by their constructor;
+the pipeline's unbound source is seeded from the fit-time datum hint) and
+flow through every node:
+
+* pure-jax / callback-backed nodes are pushed through ``jax.eval_shape``
+  over their ``trace_batch`` — tracing with abstract values only, nothing
+  computes;
+* operators whose apply is NOT abstractly evaluable declare an
+  ``out_spec(*in_item_specs)`` instead (host featurizers, per-item nodes,
+  ragged-chunk ops) — see :data:`OUT_SPEC_PROTOCOL`;
+* estimators declare ``fitted_out_spec(*in_item_specs)``: the per-item
+  spec of their fitted transformer's output, which the delegating node
+  applies to the serve path.
+
+A node whose inputs are KNOWN and whose evaluation/declaration REJECTS
+them raises a node-attributed :class:`PipelineCheckError` — the whole
+point: a dtype mismatch surfaces at construction/fit entry, not minutes
+into a featurization scan. Unknown inputs propagate as unknown; the
+checker never guesses, so it has no false positives by construction.
+
+The leading (batch) dimension is symbolic: specs seeded per-item get the
+:data:`SYMBOLIC_LEAD` placeholder, and outputs whose lead equals the
+placeholder stay symbolic. All mismatch power lives in the trailing
+(per-item) dims, which is exactly what composition can get wrong.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from .errors import PipelineCheckError
+from . import lattice
+
+logger = logging.getLogger(__name__)
+
+#: placeholder extent for the symbolic batch/lead dimension — an unlikely
+#: prime so a real dim is never confused with it in reports
+SYMBOLIC_LEAD = 11939
+
+#: protocol documentation anchor: operators may define
+#: ``out_spec(*in_item_specs) -> item_spec`` where an item spec is
+#: ``(shape_tuple, dtype_str)`` (or a tuple of item specs for multi-array
+#: values, or None for unknown); estimators analogously define
+#: ``fitted_out_spec(fit_item_specs, apply_item_specs) -> item_spec`` —
+#: the per-item spec of the FITTED transformer's output, given the specs
+#: of the estimator's fit inputs and of the serve-path input. All
+#: declarations must tolerate None entries (unknown inputs) by returning
+#: None; raising means "these KNOWN inputs are incompatible" and becomes
+#: a node-attributed PipelineCheckError.
+OUT_SPEC_PROTOCOL = "out_spec"
+FITTED_OUT_SPEC_PROTOCOL = "fitted_out_spec"
+
+
+# ---------------------------------------------------------------------------
+# abstract values
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Spec:
+    """One array-shaped abstract value: full shape (lead included) +
+    dtype. ``sym`` marks the lead dim as the symbolic batch placeholder.
+    ``chunked`` marks the value as flowing chunk-by-chunk from an
+    out-of-core scan (a materialization-barrier property, not a shape)."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    sym: bool = False
+    chunked: bool = False
+
+    @property
+    def item_shape(self) -> Tuple[int, ...]:
+        return self.shape[1:]
+
+    @property
+    def item(self) -> Tuple[Tuple[int, ...], str]:
+        return (self.item_shape, self.dtype)
+
+    def item_bytes(self) -> Optional[int]:
+        """Bytes of ONE item of this value, or None when not derivable."""
+        import numpy as np
+
+        try:
+            n = 1
+            for d in self.item_shape:
+                n *= int(d)
+            return n * np.dtype(self.dtype).itemsize
+        except TypeError:
+            logger.debug("item_bytes failed for %s", self, exc_info=True)
+            return None
+
+    def display_shape(self) -> Tuple[Optional[int], ...]:
+        """The shape with a symbolic lead rendered as None."""
+        if self.sym and self.shape:
+            return (None, *self.shape[1:])
+        return self.shape
+
+
+@dataclass(frozen=True)
+class SpecTuple:
+    """A tuple-of-arrays abstract value (gather output, split blocks)."""
+
+    elems: Tuple[Any, ...]  # Spec | SpecTuple | None
+
+    @property
+    def chunked(self) -> bool:
+        return any(getattr(e, "chunked", False) for e in self.elems)
+
+
+@dataclass(frozen=True)
+class EstimatorSpec:
+    """The abstract value of an estimator node: a transformer-to-be. The
+    operator rides along so the delegating node can consult its
+    ``fitted_out_spec`` declaration."""
+
+    op: Any
+
+
+AbstractValue = Any  # Spec | SpecTuple | EstimatorSpec | None (unknown)
+
+
+# ---------------------------------------------------------------------------
+# spec construction helpers
+# ---------------------------------------------------------------------------
+
+
+def spec_of_array(value: Any, *, chunked: bool = False) -> Optional[Spec]:
+    """Spec of an in-memory array-like, or None. Reads ONLY ``shape`` and
+    ``dtype`` attributes — never forces computation."""
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    try:
+        return Spec(
+            tuple(int(d) for d in shape), str(dtype), chunked=chunked
+        )
+    except TypeError:
+        logger.debug("unspecable array-like %r", type(value), exc_info=True)
+        return None
+
+
+def spec_from_item(
+    item: Any, *, chunked: bool = False
+) -> Optional[AbstractValue]:
+    """Lift a per-item declaration ``(shape, dtype)`` (or a tuple of them,
+    or None) into a batched abstract value with a symbolic lead."""
+    if item is None:
+        return None
+    if isinstance(item, Spec):
+        return item
+    if (
+        isinstance(item, tuple)
+        and len(item) == 2
+        and isinstance(item[1], str)
+        and isinstance(item[0], (tuple, list))
+        and all(isinstance(d, int) for d in item[0])
+    ):
+        return Spec(
+            (SYMBOLIC_LEAD, *tuple(item[0])), item[1],
+            sym=True, chunked=chunked,
+        )
+    if isinstance(item, (tuple, list)):
+        return SpecTuple(
+            tuple(spec_from_item(e, chunked=chunked) for e in item)
+        )
+    return None
+
+
+def _to_item(av: AbstractValue) -> Any:
+    """Project an abstract value down to the per-item declaration form
+    handed to out_spec/fitted_out_spec implementations."""
+    if isinstance(av, Spec):
+        return av.item
+    if isinstance(av, SpecTuple):
+        return tuple(_to_item(e) for e in av.elems)
+    return None
+
+
+def _to_struct(av: AbstractValue) -> Any:
+    """Materialize ShapeDtypeStructs for jax.eval_shape."""
+    import jax
+
+    if isinstance(av, Spec):
+        return jax.ShapeDtypeStruct(av.shape, av.dtype)
+    if isinstance(av, SpecTuple):
+        return tuple(_to_struct(e) for e in av.elems)
+    raise TypeError(f"not a concrete spec: {av!r}")
+
+
+def _fully_known(av: AbstractValue) -> bool:
+    if isinstance(av, Spec):
+        return True
+    if isinstance(av, SpecTuple):
+        return all(_fully_known(e) for e in av.elems)
+    return False
+
+
+def _from_struct(out: Any, sym_lead: bool, chunked: bool) -> AbstractValue:
+    """Lift eval_shape's result pytree back into abstract values."""
+    if hasattr(out, "shape") and hasattr(out, "dtype"):
+        shape = tuple(int(d) for d in out.shape)
+        sym = bool(sym_lead and shape and shape[0] == SYMBOLIC_LEAD)
+        return Spec(shape, str(out.dtype), sym=sym, chunked=chunked)
+    if isinstance(out, (tuple, list)):
+        return SpecTuple(
+            tuple(_from_struct(e, sym_lead, chunked) for e in out)
+        )
+    return None
+
+
+# ---------------------------------------------------------------------------
+# leaves
+# ---------------------------------------------------------------------------
+
+
+def _leaf_value(op: Any) -> AbstractValue:
+    """Abstract value of a data leaf, by CHEAP inspection only."""
+    from ..data.chunked import ChunkedDataset
+    from ..data.dataset import Dataset
+    from ..workflow.operators import DatasetOperator, DatumOperator
+
+    if isinstance(op, DatasetOperator):
+        ds = op.dataset
+        if isinstance(ds, ChunkedDataset):
+            item = getattr(ds, "item_spec", None)
+            if item is not None:
+                return spec_from_item(item, chunked=True)
+            # chunked stream of unknown element spec: the shape is
+            # unknown; the chunked-flow property rides in chunked_flow
+            return None
+        if isinstance(ds, Dataset):
+            if ds.is_batched:
+                payload = ds.payload
+                if isinstance(payload, (tuple, list)):
+                    return SpecTuple(
+                        tuple(spec_of_array(p) for p in payload)
+                    )
+                return spec_of_array(payload)
+            payload = ds.payload
+            if isinstance(payload, list) and payload:
+                # materialized item list: peeking index 0's metadata is
+                # free (no compute); ragged lists simply yield item 0's
+                # shape which downstream may or may not hold — so item
+                # lists contribute an UNKNOWN spec unless homogeneous is
+                # provable; stay conservative
+                return None
+        return None
+    if isinstance(op, DatumOperator):
+        # single-datum graphs go through single_transform, not
+        # trace_batch — stay unknown rather than guess the batch form
+        return None
+    return None
+
+
+def leaf_is_chunked(op: Any) -> bool:
+    from ..data.chunked import ChunkedDataset
+    from ..workflow.operators import DatasetOperator
+
+    return isinstance(op, DatasetOperator) and isinstance(
+        op.dataset, ChunkedDataset
+    )
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+
+def _concretization_errors() -> tuple:
+    import jax
+
+    errs = []
+    for name in (
+        "TracerArrayConversionError",
+        "ConcretizationTypeError",
+        "TracerBoolConversionError",
+        "TracerIntegerConversionError",
+    ):
+        e = getattr(jax.errors, name, None)
+        if e is not None:
+            errs.append(e)
+    return tuple(errs)
+
+
+def infer_specs(
+    graph: Any,
+    source_specs: Optional[Dict[Any, AbstractValue]] = None,
+    verdicts: Optional[Dict[Any, str]] = None,
+) -> Tuple[Dict[Any, AbstractValue], Dict[Any, str]]:
+    """Propagate abstract values through ``graph`` in topological order.
+
+    Returns ``(values, verdicts)`` — per-graph-id abstract values and the
+    (possibly downgraded) per-node verdicts. Raises
+    :class:`PipelineCheckError` on a PROVEN incompatibility: a node whose
+    inputs are fully known rejecting them, or a batch-coupled node fed an
+    unmaterialized chunked stream.
+    """
+    from ..workflow import analysis
+    from ..workflow.graph import NodeId, SourceId
+    from ..workflow.operators import (
+        DatasetOperator,
+        DatumOperator,
+        DelegatingOperator,
+        EstimatorOperator,
+        ExpressionOperator,
+        GatherTransformerOperator,
+    )
+
+    values: Dict[Any, AbstractValue] = {}
+    chunked_flow: Dict[Any, bool] = {}
+    verdicts = dict(verdicts or {})
+    for src, av in (source_specs or {}).items():
+        values[src] = av
+        chunked_flow[src] = bool(getattr(av, "chunked", False))
+
+    conc_errors = _concretization_errors()
+
+    for gid in analysis.linearize(graph):
+        if isinstance(gid, SourceId):
+            values.setdefault(gid, None)
+            chunked_flow.setdefault(gid, False)
+            continue
+        if not isinstance(gid, NodeId) or gid not in graph.operators:
+            continue
+        op = graph.get_operator(gid)
+        deps = graph.get_dependencies(gid)
+        dep_vals = [values.get(d) for d in deps]
+        dep_chunked = any(chunked_flow.get(d, False) for d in deps)
+        label = getattr(op, "label", type(op).__name__)
+
+        if gid not in verdicts:
+            verdicts[gid] = lattice.classify(op)
+        verdict = verdicts[gid]
+
+        # data leaves
+        if not deps and isinstance(op, (DatasetOperator, DatumOperator)):
+            values[gid] = _leaf_value(op)
+            chunked_flow[gid] = leaf_is_chunked(op)
+            continue
+
+        # a Cacher is the materialization point: the stream stops being
+        # chunk-at-a-time below it
+        is_cacher = type(op).__name__ == "Cacher"
+        out_chunked = dep_chunked and not is_cacher
+
+        # chunk-boundary incompatibility: a batch-coupled node consuming
+        # an out-of-core stream computes its whole-batch statistics per
+        # CHUNK — runtime refuses this mid-scan; refuse it here instead.
+        # Coupling is read from the ATTRIBUTE, not the verdict: a coupled
+        # node carrying a worse lattice trait is still coupled.
+        if getattr(op, "batch_coupled", False) and dep_chunked:
+            raise PipelineCheckError(
+                "batch-coupled node consumes an out-of-core chunked "
+                "stream: its whole-batch statistics would be computed "
+                "per chunk — materialize upstream (e.g. .cache()) first",
+                node=gid, label=label,
+            )
+
+        if isinstance(op, EstimatorOperator) and not isinstance(
+            op, DelegatingOperator
+        ):
+            values[gid] = EstimatorSpec(op)
+            chunked_flow[gid] = False
+            continue
+
+        if isinstance(op, DelegatingOperator):
+            est, data_vals = dep_vals[0], dep_vals[1:]
+            out = None
+            if isinstance(est, EstimatorSpec):
+                decl = getattr(est.op, FITTED_OUT_SPEC_PROTOCOL, None)
+                if decl is not None:
+                    est_node = deps[0]
+                    fit_in = [
+                        _to_item(values.get(d))
+                        for d in graph.get_dependencies(est_node)
+                    ]
+                    apply_in = [_to_item(v) for v in data_vals]
+                    try:
+                        out = spec_from_item(
+                            decl(fit_in, apply_in), chunked=out_chunked
+                        )
+                    except PipelineCheckError:
+                        raise
+                    except Exception as e:
+                        raise PipelineCheckError(
+                            f"declared fitted_out_spec of "
+                            f"{type(est.op).__name__} rejects the input "
+                            f"spec: {e}",
+                            node=gid, label=label,
+                        ) from e
+            values[gid] = out
+            chunked_flow[gid] = out_chunked
+            continue
+
+        if isinstance(op, ExpressionOperator):
+            expr = op.expression
+            value = expr._value if getattr(expr, "computed", False) else None
+            av = spec_of_array(value) if value is not None else None
+            values[gid] = av
+            chunked_flow[gid] = False
+            continue
+
+        if isinstance(op, GatherTransformerOperator):
+            values[gid] = (
+                SpecTuple(tuple(dep_vals))
+                if all(v is not None for v in dep_vals)
+                else None
+            )
+            chunked_flow[gid] = out_chunked
+            continue
+
+        # declared spec wins for nodes whose apply is not abstractly
+        # evaluable — and is honored even when inputs are partially
+        # unknown (the declaration may not need them)
+        decl = getattr(op, OUT_SPEC_PROTOCOL, None)
+        if decl is not None:
+            try:
+                out = decl(*[_to_item(v) for v in dep_vals])
+            except PipelineCheckError:
+                raise
+            except Exception as e:
+                raise PipelineCheckError(
+                    f"declared out_spec rejects the input spec: {e}",
+                    node=gid, label=label,
+                ) from e
+            values[gid] = spec_from_item(out, chunked=out_chunked)
+            chunked_flow[gid] = out_chunked
+            continue
+
+        fn = getattr(op, "trace_batch", None)
+        if fn is None or not all(_fully_known(v) for v in dep_vals):
+            values[gid] = None
+            chunked_flow[gid] = out_chunked
+            continue
+
+        import jax
+
+        sym_lead = any(
+            getattr(v, "sym", False)
+            or (
+                isinstance(v, SpecTuple)
+                and any(getattr(e, "sym", False) for e in v.elems)
+            )
+            for v in dep_vals
+        )
+        try:
+            out_struct = jax.eval_shape(
+                fn, *[_to_struct(v) for v in dep_vals]
+            )
+        except conc_errors:
+            # the "pure jax" classification was optimistic: this
+            # trace_batch needs concrete values. It cannot jit either —
+            # downgrade so the compile path agrees with reality.
+            logger.warning(
+                "check: %s claimed traceable but cannot be abstractly "
+                "evaluated; downgrading to opaque", label, exc_info=True,
+            )
+            verdicts[gid] = lattice.OPAQUE
+            values[gid] = None
+            chunked_flow[gid] = out_chunked
+            continue
+        except Exception as e:
+            if verdict in (lattice.TRACEABLE, lattice.BATCH_COUPLED):
+                in_desc = ", ".join(
+                    str(
+                        v.display_shape()
+                        if isinstance(v, Spec) else _to_item(v)
+                    )
+                    + (f":{v.dtype}" if isinstance(v, Spec) else "")
+                    for v in dep_vals
+                )
+                raise PipelineCheckError(
+                    f"node rejects input spec [{in_desc}]: {e}",
+                    node=gid, label=label,
+                ) from e
+            # callback-backed/stateful nodes: abstract evaluation is
+            # best-effort evidence, not a contract — unknown, not an error
+            logger.debug(
+                "check: abstract eval of %s (%s) failed; spec unknown",
+                label, verdict, exc_info=True,
+            )
+            values[gid] = None
+            chunked_flow[gid] = out_chunked
+            continue
+        values[gid] = _from_struct(out_struct, sym_lead, out_chunked)
+        chunked_flow[gid] = out_chunked
+
+    # sinks mirror their dependency
+    for sink, dep in graph.sink_dependencies.items():
+        values[sink] = values.get(dep)
+        chunked_flow[sink] = chunked_flow.get(dep, False)
+
+    return values, verdicts

@@ -1,0 +1,63 @@
+"""Typed errors for the static pipeline checker."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class PipelineCheckError(ValueError):
+    """A statically-proven pipeline defect: a shape/dtype/rank mismatch, a
+    declared-spec rejection, or a chunk-boundary-incompatible composition
+    — raised at ``and_then``/``fit()``/``check()`` entry, BEFORE any chunk
+    is produced or sample executed.
+
+    Carries the offending node's id and label so callers (and humans) see
+    exactly which stage is wrong, not a traceback from the middle of a
+    scan. Subclasses :class:`ValueError` so pre-existing broad callers
+    keep working.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        node: Any = None,
+        label: Optional[str] = None,
+    ):
+        self.node = node
+        self.label = label
+        parts = [str(p) for p in (node, label) if p is not None]
+        where = f" [at {' '.join(parts)}]" if parts else ""
+        super().__init__(message + where)
+        self._message = message
+
+    def __reduce__(self):
+        # default exception reduction would re-call __init__ with the
+        # already-decorated message, doubling the node suffix
+        return (
+            _rebuild_check_error,
+            (type(self), self._message, self.node, self.label),
+        )
+
+
+def _rebuild_check_error(cls, message, node, label):
+    return cls(message, node=node, label=label)
+
+
+class ContractMismatchError(PipelineCheckError):
+    """A pipeline's statically-derived serving contract (datum shape,
+    dtype, batch-coupling) does not match what a live engine/fleet/worker
+    requires — raised by swap/boot validation from
+    :meth:`CheckReport.require_contract`."""
+
+
+class CheckOnlyExit(Exception):
+    """Control-flow exception for the ``--check`` CLI mode: raised by
+    ``Pipeline.fit()`` after the static check ran so the pipeline main
+    unwinds without executing anything; ``__main__`` catches it and
+    reports the check outcome. Deliberately NOT a ValueError — nothing
+    should accidentally swallow it."""
+
+    def __init__(self, report):
+        self.report = report
+        super().__init__("static check complete (check-only mode)")

@@ -799,7 +799,10 @@ class ClusterRouter:
                         {"type": "stats", "seq": slot.stats_seq},
                     )
             except Exception:
-                pass
+                logger.debug(
+                    "stats request to worker %d failed", slot.index,
+                    exc_info=True,
+                )
         deadline = time.monotonic() + timeout
         out = []
         for slot in live:
@@ -910,7 +913,10 @@ class ClusterRouter:
                     with slot.send_lock:
                         send_msg(sock, {"type": "stop", "drain": drain})
                 except Exception:
-                    pass
+                    logger.debug(
+                        "stop message to worker %d failed (already dead?)",
+                        slot.index, exc_info=True,
+                    )
         import subprocess
 
         for slot in self._slots:
@@ -983,9 +989,9 @@ def settle_result(fut: Future, value: Any) -> bool:
         try:
             if not fut.set_running_or_notify_cancel():
                 return False
-        except Exception:
-            pass  # already RUNNING
+        except Exception:  # lint: allow-silent -- already RUNNING by design
+            pass
         fut.set_result(value)
         return True
-    except Exception:
+    except Exception:  # lint: allow-silent -- lost the set-once race: fine
         return False

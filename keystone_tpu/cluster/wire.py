@@ -34,6 +34,7 @@ pickle efficiently enough for a localhost hop (protocol 5).
 
 from __future__ import annotations
 
+import logging
 import pickle
 import socket
 import struct
@@ -74,6 +75,7 @@ def _registry():
         ServingError,
         Shed,
     )
+    from ..check import ContractMismatchError, PipelineCheckError
     from ..workflow.pipeline import NotTraceableError
 
     types = (
@@ -86,6 +88,8 @@ def _registry():
         CanaryMismatch,
         ServingError,
         NotTraceableError,
+        ContractMismatchError,
+        PipelineCheckError,
         WorkerError,
     )
     return {t.__name__: t for t in types}
@@ -204,4 +208,8 @@ def decode_error(enc: dict) -> BaseException:
     try:
         return cls(message)
     except Exception:
+        logging.getLogger(__name__).debug(
+            "decoding %s with a message-only constructor failed; "
+            "degrading to WorkerError", kind, exc_info=True,
+        )
         return WorkerError(kind, message)

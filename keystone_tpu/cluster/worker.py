@@ -129,6 +129,14 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     })
 
     fitted = resolve_model(spec["model"])
+    # upfront contract validation: the router's spec'd datum shape/dtype
+    # against the model's STATIC check report — a mis-deployed model
+    # (wrong artifact for this topology) fails the boot with a typed,
+    # node-attributed error instead of serving garbage or tracing a
+    # doomed bucket set (the fleet constructor re-validates coupling)
+    fitted.check(span=False).require_contract(
+        spec.get("datum_shape"), spec.get("dtype"), verb="boot"
+    )
     devices = _worker_devices(
         worker_id, int(spec.get("n_workers", 1)), spec.get("replicas")
     )
@@ -197,7 +205,11 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
                     "error": encode_error(e),
                 })
             except Exception:
-                pass  # router gone; its death handling requeues
+                # router gone; its death handling requeues
+                logger.debug(
+                    "reply for request %d undeliverable", req_id,
+                    exc_info=True,
+                )
 
     rc = 0
     try:

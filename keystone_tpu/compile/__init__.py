@@ -83,7 +83,9 @@ def configure(
     with _lock:
         _initialized = True
         if path is None:
-            path = os.environ.get("KEYSTONE_AOT_CACHE") or None
+            from ..utils import env_str
+
+            path = env_str("KEYSTONE_AOT_CACHE")
         if not path:
             _cache = None
             return None
@@ -127,7 +129,8 @@ def reset() -> None:
             try:
                 jax.config.update(name, value)
             except Exception:  # pragma: no cover - knob absent in this jax
-                pass
+                logger.debug("could not restore jax config %s", name,
+                             exc_info=True)
 
 
 def _layer_jax_compilation_cache(cache: ExecutableCache) -> None:

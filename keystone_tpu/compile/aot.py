@@ -71,6 +71,7 @@ class AotDispatcher:
         on_trace: Optional[Callable[[Signature], None]] = None,
         on_load: Optional[Callable[[Signature], None]] = None,
         label: str = "",
+        expected_exportable: Optional[bool] = None,
     ):
         self._fn = fn
         self._digest = fingerprint_digest
@@ -78,6 +79,9 @@ class AotDispatcher:
         self._on_trace = on_trace
         self._on_load = on_load
         self._label = label
+        #: the static checker's export verdict (keystone_tpu/check/),
+        #: when the caller ran one — the dynamic path asserts against it
+        self._expected_exportable = expected_exportable
         self._env = environment_key()
         self._by_sig: Dict[Signature, Callable] = {}
         self._lock = threading.Lock()
@@ -199,6 +203,16 @@ class AotDispatcher:
                 "(no cross-process caching for this signature)",
                 self._label or key, sig, exc_info=True,
             )
+            if self._expected_exportable:
+                # static-vs-dynamic disagreement: the checker's lattice
+                # said this chain exports. A verdict bug — make it loud
+                # so the classifier gets fixed, not papered over.
+                logger.error(
+                    "aot: STATIC CHECK DISAGREEMENT — the traceability "
+                    "lattice classified %s as exportable but jax.export "
+                    "refused it; report this pipeline's node set",
+                    self._label or key,
+                )
             self._traced += 1
             if fired:
                 return jax.jit(self._fn)  # already counted by the export try

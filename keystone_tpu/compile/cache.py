@@ -68,14 +68,11 @@ class ExecutableCache:
     def __init__(self, root: str, max_bytes: Optional[int] = None):
         self.root = os.path.abspath(os.path.expanduser(root))
         if max_bytes is None:
-            raw = os.environ.get("KEYSTONE_AOT_CACHE_BYTES", "")
-            try:
-                max_bytes = int(raw) if raw else DEFAULT_MAX_BYTES
-            except ValueError:
-                logger.warning(
-                    "ignoring non-integer KEYSTONE_AOT_CACHE_BYTES=%r", raw
-                )
-                max_bytes = DEFAULT_MAX_BYTES
+            from ..utils import env_int
+
+            max_bytes = env_int(
+                "KEYSTONE_AOT_CACHE_BYTES", DEFAULT_MAX_BYTES, minimum=0
+            )
         self.max_bytes = int(max_bytes)
         os.makedirs(self.entries_dir, exist_ok=True)
 
@@ -201,6 +198,9 @@ class ExecutableCache:
                 return None  # renamed / foreign file
             return CacheEntry(key=key, header=header, payload=payload, path=path)
         except Exception:
+            # unreadable/corrupt entry degrades to a miss by contract
+            logger.debug("aot cache: unreadable entry %s", path,
+                         exc_info=True)
             return None
 
     def _discard(self, path: str, why: str) -> None:

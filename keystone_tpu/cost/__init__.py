@@ -85,7 +85,9 @@ def configure(path: Optional[str] = None) -> Optional[ProfileStore]:
     with _lock:
         _initialized = True
         if path is None:
-            path = os.environ.get("KEYSTONE_PROFILE_DIR") or None
+            from ..utils import env_str
+
+            path = env_str("KEYSTONE_PROFILE_DIR")
         if not path:
             _store = None
             return None

@@ -172,6 +172,8 @@ class ProfileStore:
                 return None  # bit rot / torn copy
             return record
         except Exception:
+            # unreadable record degrades to a discarded miss by contract
+            logger.debug("profile store: unreadable record", exc_info=True)
             return None
 
     def _discard(self, path: str, why: str) -> None:

@@ -21,7 +21,6 @@ arrays (the gather node zips branch chunks into tuples).
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import jax
@@ -43,7 +42,9 @@ def default_cache_budget_bytes() -> int:
     materialized form fits comfortably is pinned; anything bigger keeps
     recompute-on-scan semantics. Override with KEYSTONE_CHUNK_CACHE_BUDGET
     (bytes)."""
-    return int(os.environ.get("KEYSTONE_CHUNK_CACHE_BUDGET", 2 << 30))
+    from ..utils import env_int
+
+    return env_int("KEYSTONE_CHUNK_CACHE_BUDGET", 2 << 30, minimum=0)
 
 
 def prefetch_to_device(chunks, depth: int = 2):
@@ -210,6 +211,13 @@ class ChunkedDataset(Dataset):
         #: rescanning); ``step=N`` is the sharded-production hook (shard
         #: s of N produces s, s+N, … — see :mod:`~keystone_tpu.data.shards`)
         self._skip_factory: Optional[Callable[..., Iterator[Any]]] = None
+        #: optional statically-known per-item ``(shape, dtype)`` of the
+        #: chunks this factory yields — set by constructors that can see
+        #: it (from_array), consumed by the static checker
+        #: (keystone_tpu/check/) so out-of-core scans carry specs without
+        #: producing a chunk. Cleared by map/map_batch (the mapped
+        #: element spec is not derivable without executing).
+        self._item_spec: Optional[tuple] = None
 
     # ---- constructors ---------------------------------------------------
 
@@ -228,6 +236,12 @@ class ChunkedDataset(Dataset):
             lambda: from_chunk(0), n, label=f"array[{n}]"
         )
         ds._skip_factory = from_chunk
+        shape = getattr(arr, "shape", None)
+        dtype = getattr(arr, "dtype", None)
+        if shape is not None and dtype is not None:
+            ds._item_spec = (
+                tuple(int(d) for d in shape[1:]), str(dtype)
+            )
         return ds
 
     @staticmethod
@@ -270,6 +284,13 @@ class ChunkedDataset(Dataset):
     @property
     def is_chunked(self) -> bool:
         return True
+
+    @property
+    def item_spec(self) -> Optional[tuple]:
+        """Statically-known per-item ``(shape, dtype)``, or None. Never
+        produces a chunk."""
+        # getattr: instances from pre-spec pickles/subclasses stay valid
+        return getattr(self, "_item_spec", None)
 
     def __len__(self) -> int:
         return self._num_rows

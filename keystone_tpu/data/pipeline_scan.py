@@ -484,8 +484,8 @@ class ScanPipeline:
                         "scan[%s]: close() failed during garbage "
                         "collection", self.stats.label, exc_info=True,
                     )
-            except Exception:
-                pass
+            except Exception:  # lint: allow-silent -- interpreter teardown:
+                pass           # the logging machinery itself may be gone
 
     def __enter__(self) -> "ScanPipeline":
         return self

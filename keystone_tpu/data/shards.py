@@ -226,8 +226,8 @@ class ShardedChunkProducer:
         try:
             if not self._closed:
                 self.close()
-        except Exception:
-            pass
+        except Exception:  # lint: allow-silent -- interpreter teardown:
+            pass           # close targets may already be collected
 
 
 def maybe_shard(

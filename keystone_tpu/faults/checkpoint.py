@@ -119,6 +119,8 @@ class FitCheckpoint:
         try:
             doc = pickle.loads(payload)
         except Exception:
+            # undecodable payload degrades to a fresh fit by contract
+            logger.debug("checkpoint: undecodable payload", exc_info=True)
             return None
         if not isinstance(doc, dict) or "state" not in doc:
             return None

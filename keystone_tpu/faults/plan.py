@@ -307,7 +307,9 @@ def active_plan() -> Optional[FaultPlan]:
     the life of the value — the determinism contract)."""
     if _installed is not None:
         return _installed
-    raw = os.environ.get("KEYSTONE_FAULTS")
+    from ..utils import env_str
+
+    raw = env_str("KEYSTONE_FAULTS")
     if not raw:
         return None
     global _env_plan, _env_raw
@@ -346,7 +348,8 @@ def fault_point(site: str, **attrs) -> None:
                 site=site, kind=kind, **attrs,
             )
     except Exception:
-        pass
+        # trace emission must never change fault semantics
+        logger.debug("fault.inject instant not recorded", exc_info=True)
     if kind == "kill":
         raise ReplicaKilled(f"injected kill at {site}")
     if kind == "fatal":

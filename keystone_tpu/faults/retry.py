@@ -18,7 +18,6 @@ WARNING and lands a ``retry.attempt`` instant in the trace.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -33,24 +32,15 @@ MAX_BACKOFF_S = 2.0
 def retry_budget_from_env() -> int:
     """KEYSTONE_SCAN_RETRIES: transient retries allowed per scan
     (default 0 — recovery is opt-in)."""
-    try:
-        return max(0, int(os.environ.get("KEYSTONE_SCAN_RETRIES", "0")))
-    except ValueError:
-        logger.warning(
-            "ignoring non-integer KEYSTONE_SCAN_RETRIES=%r",
-            os.environ.get("KEYSTONE_SCAN_RETRIES"),
-        )
-        return 0
+    from ..utils import env_int
+
+    return env_int("KEYSTONE_SCAN_RETRIES", 0, minimum=0)
 
 
 def retry_backoff_from_env() -> float:
-    try:
-        return max(
-            0.0,
-            float(os.environ.get("KEYSTONE_SCAN_RETRY_BACKOFF", "0.05")),
-        )
-    except ValueError:
-        return 0.05
+    from ..utils import env_float
+
+    return env_float("KEYSTONE_SCAN_RETRY_BACKOFF", 0.05)
 
 
 class RetryBudget:
@@ -102,7 +92,8 @@ class RetryBudget:
                     delay_s=round(delay, 4), label=self.label,
                 )
         except Exception:
-            pass
+            # trace emission must never change retry semantics
+            logger.debug("retry.attempt instant not recorded", exc_info=True)
         return delay
 
 

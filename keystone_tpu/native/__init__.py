@@ -87,7 +87,9 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, or None (no toolchain / disabled)."""
     global _LIB, _LIB_FAILED
-    if os.environ.get("KEYSTONE_NO_NATIVE"):
+    from ..utils import env_flag
+
+    if env_flag("KEYSTONE_NO_NATIVE", False):
         return None
     if _LIB is not None or _LIB_FAILED:
         return _LIB

@@ -85,6 +85,22 @@ def dense_shape_from_samples(samples, num_items: int, machines: int,
     )
 
 
+def label_dim_fitted_out_spec(fit_in, apply_in):
+    """Shared ``fitted_out_spec`` declaration (see
+    ``keystone_tpu/check/abstract.py``) for the label-estimator solver
+    families: the fitted mapper sends one feature vector to one score per
+    label column, so the output item spec IS the labels' item shape, in
+    the solvers' float32. None when the labels spec is unknown."""
+    labels = fit_in[1] if len(fit_in) > 1 else None
+    if (
+        not isinstance(labels, tuple) or len(labels) != 2
+        or not isinstance(labels[1], str)
+    ):
+        return None
+    shape, _ = labels
+    return (tuple(shape), "float32")
+
+
 class AutoSolverFrontDoor:
     """The cost-model front-door protocol shared by the auto-selecting
     estimator families (``LeastSquaresEstimator``,
@@ -99,6 +115,9 @@ class AutoSolverFrontDoor:
     families override it. ``cost`` prices the front door as its cheapest
     option, so an un-resolved auto node ranks where its best member
     would."""
+
+    def fitted_out_spec(self, fit_in, apply_in):
+        return label_dim_fitted_out_spec(fit_in, apply_in)
 
     def _init_chooser_weights(self, cpu_weight, mem_weight, network_weight):
         self.cpu_weight = (

@@ -30,7 +30,7 @@ from ...linalg import solve_blockwise_l2, solve_least_squares
 from ...parallel.mesh import shard_batch
 from ...utils.params import as_param
 from ...workflow.transformer import LabelEstimator, Transformer
-from .cost import CostModel, combine_cost
+from .cost import CostModel, combine_cost, label_dim_fitted_out_spec
 
 
 class LinearMapper(Transformer):
@@ -97,6 +97,9 @@ class LinearMapEstimator(LabelEstimator, CostModel):
         self.snapshot = snapshot
         self.checkpoint = checkpoint
         self.checkpoint_every = checkpoint_every
+
+    def fitted_out_spec(self, fit_in, apply_in):
+        return label_dim_fitted_out_spec(fit_in, apply_in)
 
     # -- sweep grid hooks (keystone_tpu/sweep/) -------------------------
 
@@ -317,6 +320,9 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
         #: start from the nearest-λ neighbor's model); consumed and
         #: cleared by ``fit`` — never part of the estimator's identity
         self.warm_start_ws: Optional[Sequence] = None
+
+    def fitted_out_spec(self, fit_in, apply_in):
+        return label_dim_fitted_out_spec(fit_in, apply_in)
 
     # passes over the input, for the auto-cache planner
     # (parity: BlockLinearMapper.scala:204)
@@ -574,6 +580,9 @@ class TSQRLeastSquaresEstimator(LabelEstimator, CostModel):
         self.lam = lam
         self.checkpoint = checkpoint
         self.checkpoint_every = checkpoint_every
+
+    def fitted_out_spec(self, fit_in, apply_in):
+        return label_dim_fitted_out_spec(fit_in, apply_in)
 
     # -- sweep grid hooks (keystone_tpu/sweep/) -------------------------
 

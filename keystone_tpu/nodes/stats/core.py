@@ -187,6 +187,10 @@ class StandardScaler(Estimator):
         self.normalize_std_dev = normalize_std_dev
         self.eps = eps
 
+    def fitted_out_spec(self, fit_in, apply_in):
+        # the fitted model is (x - mean)/std: spec-preserving
+        return apply_in[0] if apply_in else None
+
     def fit(self, data: Dataset) -> StandardScalerModel:
         from ...data.chunked import ChunkedDataset
 

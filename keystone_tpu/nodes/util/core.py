@@ -45,6 +45,11 @@ class MultiClassLabelIndicators(Transformer):
         out[np.asarray(labels, dtype=np.int64)] = 1.0
         return jnp.asarray(out)
 
+    def out_spec(self, labels_spec=None):
+        # ragged per-item host path: not abstractly evaluable, but the
+        # output spec is fully determined by construction
+        return ((self.num_classes,), "float32")
+
 
 class MaxClassifier(Transformer):
     """argmax over the score vector (parity: MaxClassifier.scala)."""
@@ -105,6 +110,23 @@ class VectorSplitter(Transformer):
         self.block_size = block_size
         self.num_features = num_features
 
+    def out_spec(self, in_spec=None):
+        # block list: not abstractly evaluable (list output), but fully
+        # determined by the input width. An unknown input spec stays
+        # unknown — fabricating a dtype would let the checker "guess",
+        # which its no-false-positives contract forbids.
+        if in_spec is None:
+            return None
+        shape, dtype = in_spec
+        if not shape:
+            raise ValueError("VectorSplitter needs a feature axis")
+        d = self.num_features or int(shape[-1])
+        lead = tuple(shape[:-1])
+        return tuple(
+            (lead + (min(self.block_size, d - i),), dtype)
+            for i in range(0, d, self.block_size)
+        )
+
     def split_batch(self, X) -> List[jnp.ndarray]:
         X = jnp.asarray(X)
         d = self.num_features or X.shape[-1]
@@ -147,6 +169,9 @@ class Shuffler(Transformer):
 
     def __init__(self, seed: int = 42):
         self.seed = seed
+
+    def out_spec(self, in_spec=None):
+        return in_spec  # a permutation is spec-preserving
 
     def apply(self, x):
         return x

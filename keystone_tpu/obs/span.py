@@ -126,5 +126,8 @@ def cheap_nbytes(value: Any) -> Optional[int]:
         if hasattr(value, "shape") and hasattr(value, "dtype"):
             return int(np.prod(value.shape)) * value.dtype.itemsize
     except Exception:
+        # sizing is best-effort by contract: a value that cannot report
+        # its bytes must never break the span that carries it
+        logger.debug("cheap_nbytes probe failed", exc_info=True)
         return None
     return None

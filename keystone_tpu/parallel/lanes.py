@@ -24,7 +24,7 @@ yields one lane — today's single-device scan path, byte-identical.
 
 from __future__ import annotations
 
-import os
+import logging
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -38,13 +38,9 @@ def scan_lanes(mesh=None) -> int:
     active mesh."""
     m = mesh if mesh is not None else default_mesh()
     n_data = int(m.shape[DATA_AXIS])
-    raw = os.environ.get("KEYSTONE_SCAN_LANES")
-    if raw is not None:
-        try:
-            return max(1, min(int(raw), n_data))
-        except ValueError:
-            pass
-    return n_data
+    from ..utils import env_int
+
+    return min(env_int("KEYSTONE_SCAN_LANES", n_data), n_data)
 
 
 def lane_devices(lanes: Optional[int] = None, mesh=None) -> List[Any]:
@@ -69,6 +65,9 @@ def _single_device(leaf: Any):
     try:
         ds = devices()
     except Exception:
+        logging.getLogger(__name__).debug(
+            "device probe on chunk leaf failed", exc_info=True
+        )
         return None
     return next(iter(ds)) if len(ds) == 1 else None
 

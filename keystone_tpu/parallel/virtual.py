@@ -8,6 +8,7 @@ Used by tests/conftest.py (fixed 8-device mesh for the suite) and by
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
 
@@ -39,6 +40,10 @@ def provision_virtual_devices(n_devices: int) -> None:
 
         initialized = xla_bridge.backends_are_initialized()
     except Exception:
+        logging.getLogger(__name__).debug(
+            "jax backend-initialization probe failed; assuming initialized",
+            exc_info=True,
+        )
         initialized = True
     if initialized:
         # Drop the live backend so the next jax.devices() re-reads the
@@ -52,7 +57,10 @@ def provision_virtual_devices(n_devices: int) -> None:
     try:
         jax.config.update("jax_num_cpu_devices", n_devices)
     except Exception:
-        pass  # older jax: the XLA_FLAGS path above still applies
+        # older jax: the XLA_FLAGS path above still applies
+        logging.getLogger(__name__).debug(
+            "jax_num_cpu_devices knob absent", exc_info=True
+        )
     if len(jax.devices()) < n_devices:
         raise RuntimeError(
             f"could not provision {n_devices} virtual CPU devices "
@@ -66,13 +74,9 @@ def provision_from_env(default: Optional[int] = None) -> int:
     — lets a 2-vCPU container exercise an 8-lane mesh scan from any entry
     point (bench subprocesses, ad-hoc repros) without editing code.
     Returns the provisioned count; 1 means no-op (real backend kept)."""
-    raw = os.environ.get("KEYSTONE_VIRTUAL_DEVICES")
-    n = default
-    if raw is not None:
-        try:
-            n = int(raw)
-        except ValueError:
-            pass
+    from ..utils import env_int
+
+    n = env_int("KEYSTONE_VIRTUAL_DEVICES", int(default or 1))
     if n is not None and n > 1:
         provision_virtual_devices(n)
         return n

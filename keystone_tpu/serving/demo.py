@@ -10,11 +10,12 @@ snapshot. The smoke path behind ``bin/serve-smoke.sh`` and the CLI's
 from __future__ import annotations
 
 import argparse
-import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 import numpy as np
+
+from ..utils import env_int
 
 
 def build_demo_fitted(
@@ -146,7 +147,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     p.add_argument(
         "--workers", type=int,
-        default=int(os.environ.get("KEYSTONE_WORKERS", "0") or 0),
+        default=env_int("KEYSTONE_WORKERS", 0, minimum=0),
         help="serve from a multi-process ClusterRouter of N worker "
              "processes (each a local fleet of --replicas workers, "
              "sharing the AOT cache dir for warm boots); default 0 = "

@@ -252,7 +252,10 @@ class ServingEngine:
                     )
                 self._reject_queued("engine worker died")
             except Exception:
-                pass
+                logger.exception(
+                    "engine worker death cleanup failed; queued requests "
+                    "may be stranded"
+                )
 
     def swap(self, fitted: FittedPipeline, *, warmup: Optional[bool] = None) -> int:
         """Atomically replace the served model with ``fitted`` — the

@@ -87,14 +87,14 @@ def settle_future(fut: Future, exc: BaseException) -> bool:
     try:
         try:
             live = fut.set_running_or_notify_cancel()
-        except Exception:
-            live = True  # already RUNNING: settle directly
+        except Exception:  # lint: allow-silent -- already RUNNING: settle
+            live = True
         if not live:
             return False  # cancelled by the caller
         fut.set_exception(exc)
         return True
-    except Exception:
-        return False  # lost a race with the real answer — fine
+    except Exception:  # lint: allow-silent -- lost the set-once race: fine
+        return False
 
 
 @dataclass
@@ -130,9 +130,13 @@ def compile_pipeline(
     at construction, never per-request under traffic."""
     import jax
 
-    fn = fitted.trace_fn()
-    if fn is None:
-        raise NotTraceableError(fitted.untraceable_nodes())
+    # ONE static check drives blockers + the trace build (trace_fn +
+    # untraceable_nodes would each re-run the whole-graph pass)
+    report = fitted.check(span=False)
+    blockers = report.untraceable_labels()
+    if blockers:
+        raise NotTraceableError(blockers)
+    fn = fitted._build_trace_fn()
 
     def _note_trace(sig):
         signatures.append(sig)
@@ -185,66 +189,54 @@ def _build_aot_dispatcher(fitted, fn, note_trace, metrics, label):
     )
 
 
+def serving_report(fitted: FittedPipeline):
+    """The static check report of a pipeline about to serve: the datum
+    contract (fit-time hint) plus the traceability verdicts every
+    serving-path validation reads (keystone_tpu/check/). One call, zero
+    executions."""
+    return fitted.check(span=False)
+
+
 def serving_contract(
     fitted: FittedPipeline,
     datum_shape: Optional[Sequence[int]],
     dtype: Any,
     *,
     verb: str = "serve",
+    report=None,
 ):
     """Resolve the per-item (shape, dtype) contract and reject chains the
-    bucket policy would silently corrupt. Explicit args win; otherwise the
-    contract recorded on the fitted pipeline at fit time is used."""
+    bucket policy would silently corrupt — via the static checker's
+    :class:`~keystone_tpu.check.CheckReport`, so the refusal carries the
+    offending NODE. Explicit args win; otherwise the contract recorded on
+    the fitted pipeline at fit time is used."""
+    if report is None:
+        report = serving_report(fitted)
     # same hazard apply_chunked guards: bucket padding repeats rows, so a
     # node computing whole-batch statistics would silently fold the
-    # padding into every real request's answer
-    coupled = fitted.batch_coupled_nodes()
-    if coupled:
-        raise ValueError(
-            f"cannot {verb} a batch-coupled chain ({coupled[0]}): bucket "
-            "padding would corrupt its whole-batch statistics — use "
-            "FittedPipeline.apply() instead"
-        )
+    # padding into every real request's answer. require_contract with an
+    # open (None) shape/dtype checks ONLY the coupling verdict here.
+    report.require_contract(None, None, verb=verb)
     # shape and dtype fall back independently — an explicit shape must not
     # discard the recorded dtype (warming float32 buckets for float64
     # traffic would re-trace every bucket under load)
     if datum_shape is None:
-        datum_shape = getattr(fitted, "datum_shape", None)
+        datum_shape = report.datum_shape
     if dtype is None:
-        dtype = getattr(fitted, "datum_dtype", None) or "float32"
+        dtype = report.datum_dtype or "float32"
     return datum_shape, dtype
 
 
 def check_swap_contract(fitted: FittedPipeline, policy: BucketPolicy) -> None:
     """A replacement model must satisfy the live datum contract (shape +
     dtype) and must not be batch-coupled — re-bucketing or re-shaping a
-    live engine/fleet is a restart, not a swap."""
-    import numpy as _np
-
-    coupled = fitted.batch_coupled_nodes()
-    if coupled:
-        raise ValueError(
-            f"cannot swap in a batch-coupled chain ({coupled[0]}): "
-            "bucket padding would corrupt its whole-batch statistics"
-        )
-    new_shape = getattr(fitted, "datum_shape", None)
-    cur_shape = policy.datum_shape
-    if (
-        new_shape is not None and cur_shape is not None
-        and tuple(new_shape) != tuple(cur_shape)
-    ):
-        raise ValueError(
-            f"swap datum shape {tuple(new_shape)} does not match the "
-            f"engine's contract {tuple(cur_shape)} — start a new engine "
-            "for a re-shaped model"
-        )
-    new_dtype = getattr(fitted, "datum_dtype", None)
-    if new_dtype is not None and _np.dtype(new_dtype) != policy.dtype:
-        raise ValueError(
-            f"swap datum dtype {_np.dtype(new_dtype)} does not match "
-            f"the engine's contract {policy.dtype} — batches would "
-            "silently cast; start a new engine for a re-typed model"
-        )
+    live engine/fleet is a restart, not a swap. Validation is the static
+    CheckReport compared against the live policy: mismatches raise the
+    typed, node-attributed
+    :class:`~keystone_tpu.check.ContractMismatchError`."""
+    serving_report(fitted).require_contract(
+        policy.datum_shape, policy.dtype, verb="swap"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -354,7 +346,12 @@ class Replica:
                                 r for r in batch if not r.future.done()
                             ]
                         except Exception:
-                            pass
+                            # best-effort annotation for the supervisor;
+                            # slots-only exceptions legitimately refuse it
+                            logger.debug(
+                                "could not attach pending batch to %r",
+                                type(e).__name__, exc_info=True,
+                            )
                     raise
                 finally:
                     self.current_batch = None
@@ -507,7 +504,7 @@ class Replica:
                 r.future.set_result(
                     jax.tree_util.tree_map(lambda a: a[i], out)
                 )
-            except Exception:
+            except Exception:  # lint: allow-silent -- set-once race:
                 # already settled — a bounded shutdown failed this wedged
                 # batch typed while it was still executing; the late real
                 # result loses the set-once race, and the REST of the

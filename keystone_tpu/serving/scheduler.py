@@ -73,8 +73,8 @@ def _chain_futures(clone, orig) -> None:
                 orig.set_exception(exc)
             else:
                 orig.set_result(done.result())
-        except Exception:
-            pass  # lost a race with another settler
+        except Exception:  # lint: allow-silent -- lost a race with
+            pass           # another settler: the designed outcome
 
     clone.add_done_callback(_copy)
 

@@ -47,6 +47,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         """Counts full-size executions; optimizer sampling probes run on
         ~24 rows and must not trip the prefix-once gate."""
 
+        # the static checker's lattice correctly flags the self-mutation
+        # below as `stateful` (a jit would freeze the counter) — but the
+        # mutation is the demo's INSTRUMENT, counting eager executions,
+        # and the serve chain is otherwise pure jax. Pin the verdict: the
+        # registry escape hatch for intentional trace-side-effects.
+        check_verdict = "traceable"
+
         def __init__(self, full_rows):
             self.full_rows = int(full_rows)
             self.full_calls = 0

@@ -514,7 +514,13 @@ class GridSweep:
                 else:
                     value = exprs[n].get()
             except Exception:
-                return  # sequential loop re-pulls and raises properly
+                # the sequential loop re-pulls this node and raises the
+                # memoized error with proper attribution
+                logger.debug(
+                    "overlapped sweep fit failed; deferring to the "
+                    "sequential pull", exc_info=True,
+                )
+                return
             if isinstance(value, TransformerOperator):
                 with lock:
                     out[n] = value

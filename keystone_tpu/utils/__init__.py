@@ -2,7 +2,10 @@ import os
 
 from .serialization import load_pickle, save_pickle
 
-__all__ = ["env_flag", "env_int", "load_pickle", "save_pickle"]
+__all__ = [
+    "env_flag", "env_float", "env_int", "env_str",
+    "load_pickle", "save_pickle",
+]
 
 
 def env_flag(name: str, default: bool = True) -> bool:
@@ -15,14 +18,55 @@ def env_flag(name: str, default: bool = True) -> bool:
     return raw.strip().lower() not in ("0", "false", "no", "off")
 
 
+#: unparsable (name, raw) pairs already warned about — misconfiguration
+#: is logged ONCE, not once per read of a hot-path knob
+_warned_env: set = set()
+
+
+def _warn_unparsable(name: str, raw: str, kind: str) -> None:
+    import logging
+
+    key = (name, raw)
+    if key not in _warned_env:
+        _warned_env.add(key)
+        logging.getLogger(__name__).warning(
+            "ignoring non-%s %s=%r; using the default", kind, name, raw
+        )
+
+
 def env_int(name: str, default: int, minimum: int = 1) -> int:
     """Shared integer parsing for KEYSTONE_* sizing env vars (worker
-    counts, depths): unset or unparsable -> ``default``; parsed values are
-    clamped to ``minimum``."""
+    counts, depths): unset or unparsable -> ``default`` (unparsable
+    values are warned once); parsed values are clamped to ``minimum``."""
     raw = os.environ.get(name)
     if raw is not None:
         try:
             return max(minimum, int(raw))
         except ValueError:
-            pass
+            _warn_unparsable(name, raw, "integer")
     return default
+
+
+def env_float(name: str, default: float, minimum: float = 0.0) -> float:
+    """Shared float parsing for KEYSTONE_* knobs (backoffs, fractions):
+    unset or unparsable -> ``default`` (unparsable values are warned
+    once); clamped to ``minimum``."""
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return max(minimum, float(raw))
+        except ValueError:
+            _warn_unparsable(name, raw, "float")
+    return default
+
+
+def env_str(name: str, default: str = None) -> str:
+    """Shared string parsing for KEYSTONE_* value env vars (paths, spec
+    strings): unset OR empty/whitespace -> ``default`` — so
+    ``KEYSTONE_X=`` reliably means "off" instead of a confusing
+    empty-string path."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    raw = raw.strip()
+    return raw if raw else default

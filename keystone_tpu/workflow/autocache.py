@@ -191,7 +191,10 @@ def _device_budget_bytes() -> int:
         if limit:
             return int(0.75 * (limit - in_use))
     except Exception:
-        pass
+        logger.debug(
+            "device memory_stats unavailable; using the 4 GiB default "
+            "cache budget", exc_info=True,
+        )
     return 4 << 30
 
 

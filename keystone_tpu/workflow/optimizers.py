@@ -106,6 +106,12 @@ def _memo_key(optimizer: "Optimizer", graph) -> Optional[tuple]:
             ops,
         )
     except Exception:
+        # an unkeyable graph bypasses the memo — correct, just slower
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "optimize memo key not derivable; bypassing", exc_info=True
+        )
         return None
 
 

@@ -196,6 +196,54 @@ def fit_instrumentation(op_type: str, span_name: str = "pipeline.fit"):
 
 
 # ---------------------------------------------------------------------------
+# Static checking (keystone_tpu/check/)
+# ---------------------------------------------------------------------------
+
+
+def _static_check(pipeline: "Pipeline", where: str):
+    """The implicit construction/fit-entry static check: zero executions,
+    raises a node-attributed PipelineCheckError on a PROVEN defect, and
+    never fails a pipeline for any other reason (internal checker faults
+    log and pass). ``KEYSTONE_STATIC_CHECK=0`` disables."""
+    from .. import check as check_mod
+
+    if not check_mod.check_enabled():
+        return None
+    try:
+        return pipeline.check(span=False)
+    except check_mod.PipelineCheckError:
+        raise
+    except Exception:
+        logger.warning(
+            "static check failed internally at %s; continuing unchecked",
+            where, exc_info=True,
+        )
+        return None
+
+
+def _emit_check_span(report, op_type: str) -> None:
+    """Record the ``check.report`` span (attrs carry the summary plus the
+    process sampling counter, so a trace can PROVE the check executed no
+    samples)."""
+    tracer = _trace_current()
+    if tracer is None or report is None:
+        return
+    from .. import cost as cost_mod
+
+    s = report.summary()
+    with tracer.span("check.report", op_type=op_type) as sp:
+        sp.attrs.update(
+            nodes=s["nodes"],
+            segments=s["segments"],
+            barriers=s["barriers"],
+            jit_compilable=s["jit_compilable"],
+            exportable=s["exportable"],
+            verdicts=dict(s["verdicts"]),
+            sampling_total=cost_mod.sampling_executions()["total"],
+        )
+
+
+# ---------------------------------------------------------------------------
 # Graph-building helpers
 # ---------------------------------------------------------------------------
 
@@ -220,6 +268,8 @@ def datum_spec_of(data: Any) -> Optional[tuple]:
             return None
         return (tuple(int(d) for d in shape[1:]), str(dtype))
     except Exception:
+        # the hint is best-effort by contract: never fail a fit over it
+        logger.debug("datum spec probe failed", exc_info=True)
         return None
 
 
@@ -299,6 +349,10 @@ class Chainable:
             # recorded as a hint for warm-up/AOT consumers of the fit
             if composed._datum_hint is None:
                 composed._datum_hint = datum_spec_of(fit_data[0])
+            # static entry check: the estimator-data path's leaf specs are
+            # known NOW, so a shape/dtype-incompatible composition raises
+            # here — at the and_then call — not minutes into the fit scan
+            _static_check(composed, where="and_then")
             return composed
         if isinstance(nxt, Chainable):
             if fit_data:
@@ -385,6 +439,34 @@ class Pipeline(Chainable):
     def __call__(self, data: Any) -> PipelineResult:
         return self.apply(data)
 
+    # -- static checking ------------------------------------------------
+
+    def check(self, datum_spec: Optional[tuple] = None, *, span: bool = True):
+        """Run the static pipeline checker (:mod:`keystone_tpu.check`)
+        over this graph: abstract shape/dtype propagation from the data
+        leaves, per-node traceability verdicts, and the
+        traceable-segment plan — in milliseconds, executing ZERO chunks
+        and ZERO samples. Raises a node-attributed
+        :class:`~keystone_tpu.check.PipelineCheckError` on any
+        statically-proven defect; returns the
+        :class:`~keystone_tpu.check.CheckReport` otherwise.
+
+        ``datum_spec`` is the per-item ``(shape, dtype)`` fed at the
+        unbound source; defaults to the recorded fit-data hint."""
+        from .. import check as check_mod
+        from .. import cost as cost_mod
+
+        spec = datum_spec if datum_spec is not None else self._datum_hint
+        report = check_mod.check_graph(
+            self._graph,
+            source=self._source,
+            datum_spec=spec,
+            cost_estimator=cost_mod.get_estimator(),
+        )
+        if span:
+            _emit_check_span(report, type(self).__name__)
+        return report
+
     # -- fitting --------------------------------------------------------
 
     def fit(self) -> "FittedPipeline":
@@ -405,7 +487,22 @@ class Pipeline(Chainable):
         costs are joined against it afterwards (``cost/replan.py``), and the
         evidence persists so the NEXT fit of this pipeline plans with zero
         sampling executions. A fit-local tracer is installed when none is
-        active — observations are what the loop learns from."""
+        active — observations are what the loop learns from.
+
+        Fit entry runs the static checker first
+        (:mod:`keystone_tpu.check`): a proven shape/dtype mismatch or
+        chunk-incompatible composition raises a node-attributed
+        :class:`~keystone_tpu.check.PipelineCheckError` BEFORE the
+        optimizer samples anything or a chunk is produced. In ``--check``
+        mode the fit stops there by design
+        (:class:`~keystone_tpu.check.CheckOnlyExit`)."""
+        from .. import check as check_mod
+
+        if check_mod.check_only_mode():
+            report = self.check()  # raises on proven defects, spans
+            print(report.render())
+            raise check_mod.CheckOnlyExit(report)
+        _static_check(self, where="fit")
         with fit_instrumentation(type(self).__name__):
             return self._fit()
 
@@ -587,35 +684,54 @@ class FittedPipeline(Chainable):
                 labels.append(op.label)
         return labels
 
+    def check(self, datum_spec: Optional[tuple] = None, *, span: bool = True):
+        """Static check of the fitted chain (see :meth:`Pipeline.check`).
+        Not memoized: tests and tools may mutate operator flags post-fit,
+        and the whole pass costs milliseconds."""
+        from .. import check as check_mod
+        from .. import cost as cost_mod
+
+        spec = datum_spec
+        if spec is None and self.datum_shape is not None:
+            spec = (self.datum_shape, self.datum_dtype or "float32")
+        report = check_mod.check_graph(
+            self._graph,
+            source=self._source,
+            datum_spec=spec,
+            cost_estimator=cost_mod.get_estimator(),
+        )
+        if span:
+            _emit_check_span(report, type(self).__name__)
+        return report
+
     def untraceable_nodes(self) -> List[str]:
-        """Labels of nodes that block whole-chain compilation (no
-        ``trace_batch``). Empty list ⇒ the pipeline compiles."""
-        labels = []
-        for node in self._graph.nodes:
-            op = self._graph.get_operator(node)
-            if isinstance(op, GatherTransformerOperator):
-                continue
-            if getattr(op, "trace_batch", None) is None:
-                labels.append(op.label)
-        return labels
+        """Labels of nodes that block whole-chain compilation — the
+        STATIC verdict (``keystone_tpu/check/``: ``opaque`` — no
+        ``trace_batch`` — or ``stateful``), not a try-trace probe. Empty
+        list ⇒ the pipeline jit-compiles."""
+        return self.check(span=False).untraceable_labels()
 
     @property
     def is_traceable(self) -> bool:
         return not self.untraceable_nodes()
 
     def trace_fn(self) -> Optional[Callable]:
-        """Build one pure function (stacked-array in → stacked-array out) from
-        the transformer DAG, if every node exposes ``trace_batch``.
+        """Build one pure function (stacked-array in → stacked-array out)
+        from the transformer DAG, if the static checker clears every node.
 
         Returns None when any node is untraceable (host-side, ragged, ...);
         :meth:`untraceable_nodes` names the blockers.
         """
-        graph, source, sink = self._graph, self._source, self._sink
-
         blockers = self.untraceable_nodes()
         if blockers:
             logger.debug("pipeline not traceable: %s", ", ".join(blockers))
             return None
+        return self._build_trace_fn()
+
+    def _build_trace_fn(self) -> Callable:
+        """The raw chain builder — callers must have cleared
+        :meth:`untraceable_nodes` first."""
+        graph, source, sink = self._graph, self._source, self._sink
 
         order = [n for n in analysis.linearize(graph) if isinstance(n, NodeId)]
 
@@ -678,11 +794,17 @@ class FittedPipeline(Chainable):
         """
         import jax
 
-        fn = self.trace_fn()
-        if fn is None:
+        # one static check drives the whole compile decision: blockers
+        # raise typed BEFORE any tracing, and the export verdict steers
+        # the AOT path (a host-callback chain jits but cannot export —
+        # attempting the export would only fail after a full trace)
+        report = self.check(span=False)
+        blockers = report.untraceable_labels()
+        if blockers:
             if strict:
-                raise NotTraceableError(self.untraceable_nodes())
+                raise NotTraceableError(blockers)
             return None
+        fn = self._build_trace_fn()
         # counts are per-live-jit (same contract __getstate__ enforces):
         # a recompile replaces the executable, so stale signatures from the
         # discarded jit would report phantom recompiles
@@ -694,7 +816,9 @@ class FittedPipeline(Chainable):
             if on_trace is not None:
                 on_trace(sig)
 
-        aot = self._aot_dispatcher(fn, cache, note_trace)
+        aot = self._aot_dispatcher(
+            fn, cache, note_trace, exportable=report.exportable
+        )
         if aot is not None:
             self._compiled = aot
             return self._compiled
@@ -710,15 +834,27 @@ class FittedPipeline(Chainable):
         return self._compiled
 
     def _aot_dispatcher(
-        self, fn: Callable, cache: Any, note_trace: Callable
+        self,
+        fn: Callable,
+        cache: Any,
+        note_trace: Callable,
+        exportable: Optional[bool] = None,
     ) -> Optional[Callable]:
         """Build the cache-aware per-signature dispatcher, or None when AOT
-        caching is off / the pipeline cannot be content-keyed."""
+        caching is off / the pipeline cannot be content-keyed / the static
+        checker proved the chain cannot export (host callbacks)."""
         from .. import compile as compile_mod
 
         if cache == "auto":
             cache = compile_mod.get_cache()
         if cache is None:
+            return None
+        if exportable is False:
+            logger.info(
+                "aot cache skipped (static checker: chain is not "
+                "exportable — host-callback/stateful nodes); using "
+                "in-process jit"
+            )
             return None
         try:
             digest = self.fingerprint()
@@ -731,7 +867,9 @@ class FittedPipeline(Chainable):
             logger.warning("aot cache skipped (fingerprinting failed)", exc_info=True)
             return None
         return compile_mod.AotDispatcher(
-            fn, digest, cache, on_trace=note_trace, label="pipeline.compile"
+            fn, digest, cache, on_trace=note_trace,
+            label="pipeline.compile",
+            expected_exportable=bool(exportable),
         )
 
     @property

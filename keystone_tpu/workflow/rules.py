@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import analysis
 from .env import PipelineEnv
-from .graph import Graph, NodeId, SourceId
+from .graph import Graph, GraphError, NodeId, SourceId
 from .operators import (
     Cacheable,
     DelegatingOperator,
@@ -151,8 +151,8 @@ class UnusedBranchRemovalRule(Rule):
             for n in list(unused):
                 try:
                     graph = graph.remove_node(n)
-                except Exception:
-                    continue
+                except GraphError:
+                    continue  # still referenced; later iterations free it
                 unused.remove(n)
                 progressed = True
             if not progressed:  # pragma: no cover - cycle guard

@@ -1,0 +1,310 @@
+"""Static-vs-dynamic agreement over the example pipeline families
+(keystone_tpu/pipelines/): for every fitted chain,
+
+* the static untraceable set equals EXACTLY the labels
+  ``NotTraceableError`` reports (zero false positives/negatives), and a
+  clean verdict means ``compile(strict=True)`` actually builds;
+* the static export verdict agrees with ``jax.export`` reality:
+  statically-exportable chains export, statically-flagged ones
+  (host callbacks) refuse.
+
+Cheap fits are real fits at tiny configs; the expensive image families
+(VOC SIFT-Fisher, ImageNet SIFT+LCS, RandomPatchCifar) are exercised as
+fitted transformer chains built from their real node classes with random
+parameters — the verdict is a property of the NODE SET, and this keeps
+the agreement sweep off the multi-minute e2e fit paths their own tests
+already cover.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow.pipeline import NotTraceableError
+
+
+def _fit_mnist():
+    from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        build_featurizer,
+        synthetic_mnist,
+    )
+
+    conf = MnistRandomFFTConfig(num_ffts=2, block_size=512, lam=100.0)
+    train, _ = synthetic_mnist(n_train=128, n_test=16)
+    labels = ClassLabelIndicators(10).apply_batch(train.labels)
+    pipe = (
+        build_featurizer(conf)
+        .and_then(
+            BlockLeastSquaresEstimator(512, 1, 100.0), train.data, labels
+        )
+        .and_then(MaxClassifier())
+    )
+    return pipe.fit(), (784,), "float32"
+
+
+def _fit_timit():
+    from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+    from keystone_tpu.pipelines.timit import TimitConfig, build_featurizer
+
+    conf = TimitConfig(num_cosines=2, num_classes=5)
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 440).astype(np.float32)
+    y = ClassLabelIndicators(5).apply_batch(
+        rng.randint(0, 5, size=96).astype(np.int32)
+    )
+    pipe = (
+        build_featurizer(conf)
+        .and_then(BlockLeastSquaresEstimator(1024, 1, 1.0), X, y)
+        .and_then(MaxClassifier())
+    )
+    return pipe.fit(), (440,), "float32"
+
+
+def _fit_linear_pixels():
+    from keystone_tpu.nodes.images.core import GrayScaler, ImageVectorizer
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(48, 8, 8, 3).astype(np.float32)
+    y = ClassLabelIndicators(4).apply_batch(
+        rng.randint(0, 4, size=48).astype(np.int32)
+    )
+    pipe = (
+        GrayScaler()
+        .and_then(ImageVectorizer())
+        .and_then(LinearMapEstimator(1.0), imgs, y)
+        .and_then(MaxClassifier())
+    )
+    return pipe.fit(), (8, 8, 3), "float32"
+
+
+def _chain_random_patch_cifar():
+    from keystone_tpu.nodes.images.core import (
+        Convolver,
+        ImageVectorizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+    from keystone_tpu.nodes.learning.linear import BlockLinearMapper
+    from keystone_tpu.nodes.stats import StandardScalerModel
+    from keystone_tpu.nodes.util import MaxClassifier
+
+    rng = np.random.RandomState(0)
+    filters = rng.randn(4, 6 * 6 * 3).astype(np.float32)
+    conv = Convolver(filters, 16, 16, 3, normalize_patches=True)
+    feat_dim = 4 * 6 * 6  # pooled map → vectorized; exact value probed
+    pipe = (
+        conv
+        .and_then(SymmetricRectifier(alpha=0.25))
+        .and_then(Pooler(6, 6, None, "sum"))
+        .and_then(ImageVectorizer())
+        .to_pipeline()
+    )
+    probe = pipe.fit()
+    rep = probe.check(datum_spec=((16, 16, 3), "float32"), span=False)
+    d = int(rep.sink_spec.item_shape[0])
+    full = probe.to_pipeline().and_then(
+        StandardScalerModel(np.zeros(d, np.float32))
+    ).and_then(
+        BlockLinearMapper(
+            [rng.randn(d, 4).astype(np.float32)], d,
+            b=np.zeros(4, np.float32),
+        )
+    ).and_then(MaxClassifier())
+    return full.fit(), (16, 16, 3), "float32"
+
+
+def _chain_voc_sift_fisher():
+    from keystone_tpu.nodes.images.core import GrayScaler, PixelScaler
+    from keystone_tpu.nodes.images.fisher_vector import FisherVector
+    from keystone_tpu.nodes.images.sift import SIFTExtractor
+    from keystone_tpu.nodes.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.nodes.learning.linear import BlockLinearMapper
+    from keystone_tpu.nodes.learning.pca import BatchPCATransformer
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+    from keystone_tpu.nodes.util import Cacher
+    from keystone_tpu.nodes.util.core import MatrixVectorizer
+
+    rng = np.random.RandomState(0)
+    k, pdim = 3, 8
+    gmm = GaussianMixtureModel(
+        rng.rand(pdim, k).astype(np.float32),
+        rng.rand(pdim, k).astype(np.float32) + 0.5,
+        np.full(k, 1.0 / k, np.float32),
+    )
+    fv_dim = 2 * pdim * k
+    pipe = (
+        PixelScaler()
+        .and_then(GrayScaler())
+        .and_then(Cacher())
+        .and_then(SIFTExtractor(step=8, num_scales=1))
+        .and_then(BatchPCATransformer(
+            rng.randn(128, pdim).astype(np.float32)  # (d, dims)
+        ))
+        .and_then(FisherVector(gmm))
+        .and_then(MatrixVectorizer())
+        .and_then(NormalizeRows())
+        .and_then(SignedHellingerMapper())
+        .and_then(NormalizeRows())
+        .and_then(BlockLinearMapper(
+            [rng.randn(fv_dim, 2).astype(np.float32)], fv_dim,
+            b=np.zeros(2, np.float32),
+        ))
+        .to_pipeline()
+    )
+    return pipe.fit(), (32, 32, 3), "float32"
+
+
+def _chain_imagenet_sift_lcs():
+    from keystone_tpu.nodes.images.core import GrayScaler, PixelScaler
+    from keystone_tpu.nodes.images.lcs import LCSExtractor
+    from keystone_tpu.nodes.images.sift import SIFTExtractor
+    from keystone_tpu.nodes.learning.pca import BatchPCATransformer
+    from keystone_tpu.nodes.util.core import MatrixVectorizer
+    from keystone_tpu.workflow.pipeline import Pipeline
+    from keystone_tpu.nodes.util import VectorCombiner
+
+    rng = np.random.RandomState(0)
+    sift = (
+        PixelScaler()
+        .and_then(GrayScaler())
+        .and_then(SIFTExtractor(step=8, num_scales=1))
+        .and_then(BatchPCATransformer(
+            rng.randn(128, 8).astype(np.float32)  # (d, dims)
+        ))
+        .and_then(MatrixVectorizer())
+    )
+    lcs = (
+        PixelScaler()
+        .and_then(LCSExtractor(4, 4, 2))
+        .and_then(MatrixVectorizer())
+    )
+    pipe = Pipeline.gather([sift, lcs]).and_then(VectorCombiner())
+    return pipe.fit(), (32, 32, 3), "float32"
+
+
+def _fit_newsgroups():
+    from keystone_tpu.pipelines.newsgroups import (
+        NewsgroupsConfig,
+        build_predictor,
+        synthetic_newsgroups,
+    )
+
+    train = synthetic_newsgroups(64, num_classes=3, seed=1)
+    conf = NewsgroupsConfig(n_grams=1, common_features=300, num_classes=3)
+    pipe = build_predictor(train.data, train.labels, conf)
+    return pipe.fit(), None, None
+
+
+def _fit_amazon():
+    from keystone_tpu.pipelines.amazon_reviews import (
+        AmazonReviewsConfig,
+        build_predictor,
+        synthetic_reviews,
+    )
+
+    train = synthetic_reviews(64, seed=1)
+    conf = AmazonReviewsConfig(n_grams=1, common_features=300, num_iters=2)
+    pipe = build_predictor(train.data, train.labels, conf)
+    return pipe.fit(), None, None
+
+
+def _fit_stupid_backoff():
+    from keystone_tpu.pipelines.stupid_backoff_pipeline import (
+        synthetic_corpus,
+        train_language_model,
+    )
+
+    model = train_language_model(synthetic_corpus(40, seed=0), n=2)
+    return model.to_pipeline().fit(), None, None
+
+
+def _fit_stall_callback():
+    from keystone_tpu.cluster.demo import build_stall_model
+
+    return build_stall_model(d=16, k=4, stall_s=0.0), (16,), "float32"
+
+
+FAMILIES = {
+    "MnistRandomFFT": _fit_mnist,
+    "TimitPipeline": _fit_timit,
+    "LinearPixels": _fit_linear_pixels,
+    "RandomPatchCifar": _chain_random_patch_cifar,
+    "VOCSIFTFisher": _chain_voc_sift_fisher,
+    "ImageNetSiftLcsFV": _chain_imagenet_sift_lcs,
+    "NewsgroupsPipeline": _fit_newsgroups,
+    "AmazonReviewsPipeline": _fit_amazon,
+    "StupidBackoffPipeline": _fit_stupid_backoff,
+    "HostCallbackServe": _fit_stall_callback,
+}
+
+#: families whose chains are expected untraceable (text/host per-item)
+EXPECT_UNTRACEABLE = {
+    "NewsgroupsPipeline", "AmazonReviewsPipeline", "StupidBackoffPipeline",
+}
+#: families that jit but must NOT export (host callbacks)
+EXPECT_NO_EXPORT = {"HostCallbackServe"}
+
+
+def _dynamic_untraceable(fitted):
+    """Ground truth for NotTraceableError's node set, computed the way
+    the pre-checker code did: trace_batch attribute presence."""
+    from keystone_tpu.workflow.operators import GatherTransformerOperator
+
+    labels = []
+    for node in fitted.graph.nodes:
+        op = fitted.graph.get_operator(node)
+        if isinstance(op, GatherTransformerOperator):
+            continue
+        if getattr(op, "trace_batch", None) is None:
+            labels.append(op.label)
+    return labels
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_static_verdicts_agree_with_dynamic_reality(family):
+    import jax
+
+    fitted, item_shape, dtype = FAMILIES[family]()
+    report = fitted.check(span=False)
+    static_untraceable = report.untraceable_labels()
+
+    # 1. exact agreement with the attribute-level ground truth
+    assert sorted(static_untraceable) == sorted(
+        _dynamic_untraceable(fitted)
+    ), family
+
+    if family in EXPECT_UNTRACEABLE:
+        assert static_untraceable, f"{family} unexpectedly traceable"
+    elif family not in EXPECT_NO_EXPORT:
+        assert not static_untraceable, (
+            f"{family} unexpectedly blocked: {static_untraceable}"
+        )
+
+    # 2. NotTraceableError reports EXACTLY the statically-flagged nodes
+    if static_untraceable:
+        with pytest.raises(NotTraceableError) as ei:
+            fitted.compile(strict=True, cache=None)
+        assert sorted(ei.value.labels) == sorted(static_untraceable)
+        return
+
+    # 3. a clean verdict actually jit-compiles
+    assert fitted.compile(strict=True, cache=None) is not None
+
+    # 4. export verdict agrees with jax.export reality
+    if item_shape is None:
+        return
+    from jax import export as jax_export
+
+    spec = jax.ShapeDtypeStruct((2, *item_shape), np.dtype(dtype))
+    exported_jit = jax.jit(fitted.trace_fn())
+    if report.exportable:
+        jax_export.export(exported_jit)(spec)  # must not raise
+    else:
+        assert family in EXPECT_NO_EXPORT
+        with pytest.raises(Exception):
+            jax_export.export(exported_jit)(spec)

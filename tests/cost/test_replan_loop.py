@@ -220,25 +220,44 @@ def test_observed_by_node_windows_out_prior_fits():
 
 def test_repeat_traced_fits_do_not_accumulate_observed_seconds(tmp_path):
     """Two fits of one pipeline under ONE global tracer: the plan record
-    after fit 2 must hold fit-2-window seconds, not fit1+fit2 sums."""
-    cost.configure(str(tmp_path))
-    PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
-    tracer = tracer_mod.install(tracer_mod.Tracer())
-    try:
-        _fit_and_apply()
-        fp = [k for k in cost.get_store().keys() if k.startswith("plan/")][0]
-        rec1 = cost.get_store().load(fp)
+    after fit 2 must hold fit-2-window seconds, not fit1+fit2 sums.
+
+    The assertion is a wall-clock ratio over ~10ms of measured work on
+    shared vCPUs, so one OS-scheduling hiccup in fit 2 can breach the
+    margin without any accumulation bug; a genuine unwindowed join fails
+    EVERY attempt (it deterministically sums both fits' spans), so the
+    scenario retries in a fresh store before failing."""
+
+    def attempt(store_dir):
+        cost.configure(str(store_dir))
         PipelineEnv.get_or_create().reset()
         PipelineEnv.get_or_create().set_optimizer(AutoCachingOptimizer())
-        _fit_and_apply()
-        rec2 = cost.get_store().load(fp)
-    finally:
-        tracer_mod.stop()
-    s1 = sum(r["seconds"] for r in rec1["nodes"].values())
-    s2 = sum(r["seconds"] for r in rec2["nodes"].values())
+        tracer = tracer_mod.install(tracer_mod.Tracer())
+        try:
+            _fit_and_apply()
+            fp = [
+                k for k in cost.get_store().keys() if k.startswith("plan/")
+            ][0]
+            rec1 = cost.get_store().load(fp)
+            PipelineEnv.get_or_create().reset()
+            PipelineEnv.get_or_create().set_optimizer(
+                AutoCachingOptimizer()
+            )
+            _fit_and_apply()
+            rec2 = cost.get_store().load(fp)
+        finally:
+            tracer_mod.stop()
+        s1 = sum(r["seconds"] for r in rec1["nodes"].values())
+        s2 = sum(r["seconds"] for r in rec2["nodes"].values())
+        return s1, s2
+
     # fit 2 is evidence-planned (no sampling) so it can be faster, but an
     # unwindowed join would sum both fits' spans: >= ~2x fit 1's seconds
-    assert s2 < 1.5 * s1
+    for trial in range(3):
+        s1, s2 = attempt(tmp_path / f"store{trial}")
+        if s2 < 1.5 * s1:
+            return
+    assert s2 < 1.5 * s1, (s1, s2)
 
 
 def test_estimate_rows_do_not_inherit_stale_extras_across_passes():

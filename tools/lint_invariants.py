@@ -1,0 +1,346 @@
+"""Repo-invariant AST lint over ``keystone_tpu/``.
+
+Mechanical enforcement of three hygiene invariants this codebase has had
+to fix by review more than once, plus the env-knob routing rule:
+
+1. **No silent broad excepts.** A ``except:`` / ``except Exception:`` /
+   ``except BaseException:`` handler must either re-raise, log what it
+   swallowed (any ``logger.*`` / ``logging.*`` / ``warnings.warn`` call,
+   or a delegated ``self._warn``-style helper whose name contains
+   ``log``/``warn``), or *consume* the bound exception (``except
+   Exception as e:`` with ``e`` referenced in the body — the error is
+   being encoded, routed, or reported, not dropped). Narrow excepts
+   (``except ValueError:``) are exempt: catching a *specific* error type
+   is itself the evidence of intent.
+
+2. **Env switches go through ``utils.env_flag``.** A direct
+   ``os.environ.get(...)`` / ``os.getenv(...)`` used in a boolean context
+   (``if``/``while``/``not``/``and``/``or``/``bool()``/ternary/``assert``)
+   re-invents truthy parsing — and historically disagreed with every
+   other knob about whether ``"0"`` means off. Related routing rule: any
+   read of a ``KEYSTONE_*`` knob outside ``keystone_tpu/utils/`` must go
+   through the shared accessors (``env_flag`` / ``env_int`` /
+   ``env_float`` / ``env_str``), so every knob parses identically.
+
+3. **Locks are held via ``with``.** A bare ``<lock>.acquire()`` call
+   statement leaks the lock on any exception before the matching
+   ``release()``; ``with lock:`` cannot. (``acquire(timeout=...)`` used
+   as an *expression* — polling, try-locks — is allowed; it returns a
+   bool the caller must branch on.)
+
+Run as a script (``python tools/lint_invariants.py [root]``, exits 1 on
+violations) or via :func:`lint_tree` (the tier-1 test in
+``tests/test_lint_invariants.py`` does the latter, so CI enforces all of
+this on every PR).
+
+An intentional exception to a rule carries an inline pragma on the
+offending line::
+
+    except Exception:  # lint: allow-silent -- <why this must stay quiet>
+
+Pragmas: ``allow-silent`` (rule 1), ``allow-env`` (rule 2),
+``allow-acquire`` (rule 3). Each requires a trailing justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+#: handler calls that count as "logged": attribute chains rooted at one of
+#: these names (logger.warning, logging.exception, warnings.warn, ...)
+_LOG_ROOTS = {"logger", "logging", "log", "warnings"}
+#: ...or any method whose name contains one of these fragments
+#: (self._warn_once, obs.rate_limited_log, ...)
+_LOG_NAME_FRAGMENTS = ("log", "warn", "exception")
+
+_BROAD_EXCEPTION_NAMES = {"Exception", "BaseException"}
+
+_PRAGMAS = {
+    "silent": "lint: allow-silent",
+    "env": "lint: allow-env",
+    "acquire": "lint: allow-acquire",
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# rule 1: silent broad excepts
+# ---------------------------------------------------------------------------
+
+
+def _is_log_call(call: ast.Call) -> bool:
+    func = call.func
+    # walk an attribute chain to its root name, remembering the leaf name
+    leaf = None
+    while isinstance(func, ast.Attribute):
+        if leaf is None:
+            leaf = func.attr
+        func = func.value
+    root = func.id if isinstance(func, ast.Name) else None
+    if root in _LOG_ROOTS:
+        return True
+    name = leaf or root or ""
+    return any(f in name.lower() for f in _LOG_NAME_FRAGMENTS)
+
+
+def _handler_logs_or_raises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and _is_log_call(node):
+            return True
+        # `except Exception as e:` with `e` read in the body: the error is
+        # consumed (encoded over a wire, handed to a supervisor, stored on
+        # a future), not silently dropped
+        if (
+            handler.name is not None
+            and isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id == handler.name
+        ):
+            return True
+    return False
+
+
+def _is_broad_except(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_EXCEPTION_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD_EXCEPTION_NAMES
+            for e in t.elts
+        )
+    return False
+
+
+def _check_excepts(tree: ast.AST, path: str, pragmas: Dict[int, Set[str]]) -> Iterator[Violation]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_except(node):
+            continue
+        if "silent" in pragmas.get(node.lineno, ()):
+            continue
+        if _handler_logs_or_raises(node):
+            continue
+        kind = "bare except" if node.type is None else "broad except"
+        yield Violation(
+            path, node.lineno, "silent-except",
+            f"{kind} swallows the error without logging or re-raising — "
+            "log it, re-raise, or narrow the exception type",
+        )
+
+
+# ---------------------------------------------------------------------------
+# rule 2: env reads
+# ---------------------------------------------------------------------------
+
+
+def _is_environ_read(call: ast.Call) -> bool:
+    """Matches ``os.environ.get(...)`` and ``os.getenv(...)``."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "get":
+            v = func.value
+            if (
+                isinstance(v, ast.Attribute) and v.attr == "environ"
+                and isinstance(v.value, ast.Name)
+            ):
+                return True
+        if func.attr == "getenv" and isinstance(func.value, ast.Name):
+            return True
+    return False
+
+
+def _environ_key(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant):
+        v = call.args[0].value
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def _boolean_context_reads(tree: ast.AST) -> Iterator[ast.Call]:
+    """environ reads whose value is consumed as a truth value."""
+
+    def tests_of(node: ast.AST) -> List[ast.AST]:
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            return [node.test]
+        if isinstance(node, ast.Assert):
+            return [node.test]
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return [node.operand]
+        if isinstance(node, ast.BoolOp):
+            return list(node.values)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "bool"
+        ):
+            return list(node.args)
+        return []
+
+    for node in ast.walk(tree):
+        for test in tests_of(node):
+            # the read itself is the truth value (not e.g. a comparison of it)
+            if isinstance(test, ast.Call) and _is_environ_read(test):
+                yield test
+
+
+def _check_env_reads(
+    tree: ast.AST, path: str, rel: str, pragmas: Dict[int, Set[str]]
+) -> Iterator[Violation]:
+    in_utils = rel.replace(os.sep, "/").startswith("keystone_tpu/utils/")
+    flagged: Set[int] = set()
+    for call in _boolean_context_reads(tree):
+        if in_utils or "env" in pragmas.get(call.lineno, ()):
+            continue
+        flagged.add(call.lineno)
+        key = _environ_key(call) or "<dynamic>"
+        yield Violation(
+            path, call.lineno, "env-truthiness",
+            f"os.environ read of {key} used as a truth value — route it "
+            "through utils.env_flag so every knob parses 0/false/no/off "
+            "identically",
+        )
+    if in_utils:
+        return
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_environ_read(node)):
+            continue
+        if node.lineno in flagged or "env" in pragmas.get(node.lineno, ()):
+            continue
+        key = _environ_key(node)
+        if key is None or not key.startswith("KEYSTONE_"):
+            continue
+        yield Violation(
+            path, node.lineno, "env-knob-routing",
+            f"direct os.environ read of {key} — use utils.env_flag / "
+            "env_int / env_float / env_str so every knob parses and "
+            "clamps identically",
+        )
+
+
+# ---------------------------------------------------------------------------
+# rule 3: bare lock acquire
+# ---------------------------------------------------------------------------
+
+
+def _check_acquires(tree: ast.AST, path: str, pragmas: Dict[int, Set[str]]) -> Iterator[Violation]:
+    for node in ast.walk(tree):
+        # only acquire() as a *statement*: an acquire whose return value is
+        # consumed (try-lock / timeout polling) must be branch-handled and
+        # cannot be expressed as `with`
+        if not isinstance(node, ast.Expr):
+            continue
+        call = node.value
+        if not (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == "acquire"
+        ):
+            continue
+        if "acquire" in pragmas.get(node.lineno, ()):
+            continue
+        yield Violation(
+            path, node.lineno, "bare-acquire",
+            "bare .acquire() statement — hold the lock via `with lock:` "
+            "so exceptions between acquire and release cannot leak it",
+        )
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _pragma_lines(source: str) -> Dict[int, Set[str]]:
+    """Pragmas per line. Only honored in COMMENT position (after a `#`,
+    so string literals containing the marker text don't suppress
+    findings) and only WITH the required `-- <justification>` suffix —
+    a reasonless marker is ignored, keeping the documented contract
+    enforced rather than aspirational."""
+    import re
+
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        hash_pos = line.find("#")
+        if hash_pos < 0:
+            continue
+        comment = line[hash_pos:]
+        for name, marker in _PRAGMAS.items():
+            if re.search(
+                re.escape(marker) + r"\s*--\s*\S", comment
+            ):
+                out.setdefault(i, set()).add(name)
+    return out
+
+
+def lint_file(path: str, rel: Optional[str] = None) -> List[Violation]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, "syntax", str(e))]
+    rel = rel if rel is not None else path
+    pragmas = _pragma_lines(source)
+    out: List[Violation] = []
+    out.extend(_check_excepts(tree, path, pragmas))
+    out.extend(_check_env_reads(tree, path, rel, pragmas))
+    out.extend(_check_acquires(tree, path, pragmas))
+    return out
+
+
+def lint_tree(root: str) -> List[Violation]:
+    """Lint every ``.py`` under ``root`` (skipping caches); returns all
+    violations sorted by (path, line)."""
+    violations: List[Violation] = []
+    base = os.path.dirname(os.path.abspath(root)) or "."
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, base)
+            violations.extend(lint_file(path, rel))
+    violations.sort(key=lambda v: (v.path, v.line))
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "keystone_tpu",
+    )
+    violations = lint_tree(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} invariant violation(s)")
+        return 1
+    print(f"lint OK: {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
