@@ -3470,6 +3470,205 @@ def bench_fault_tolerance() -> dict:
     }
 
 
+def bench_continual_learning() -> dict:
+    """The closed continual-learning loop (keystone_tpu/trainer/) under a
+    sustained traffic trace: >= 3 model refreshes promoted hands-free,
+    one injected bad refresh canary-rolled-back, and one replica killed
+    inside an open canary window — while closed-loop clients hammer the
+    fleet throughout.
+
+    Gates:
+      * zero_failed_requests_ok — not one request failed or dropped
+        across every refresh, the rollback, and the replica kill
+        (completed == submitted, no client-side exceptions);
+      * refreshes_ok — every good batch promoted (>= 3 refreshes,
+        fleet version advanced in lockstep, zero replica version skew);
+      * rollback_bitequal_ok — the poisoned batch rolled back and was
+        parked, and probe outputs after the rollback are BIT-equal to
+        before it (the old executable never stopped serving);
+      * replica_kill_ok — the mid-window kill was absorbed: supervised
+        restart >= 1, no version skew after recovery;
+      * absorb_scan_count_ok — absorb work is O(new chunks): every
+        appended chunk was produced EXACTLY once across the whole run
+        (already-promoted batches are never rescanned by later
+        refreshes; the served training set never re-produces at all).
+    """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from keystone_tpu import faults
+    from keystone_tpu.serving import ServingFleet
+    from keystone_tpu.trainer import ChunkLog, TrainerDaemon
+    from keystone_tpu.trainer.demo import build_trainer_fitted
+
+    d = 16
+    chunk_rows = 64
+    fitted, make, X0 = build_trainer_fitted(
+        d=d, n_train=512, chunk_rows=chunk_rows
+    )
+    fleet = ServingFleet(
+        fitted, replicas=2, buckets=(8,), datum_shape=(d,),
+        max_wait_ms=1.0, max_queue=2048,
+    )
+    log = ChunkLog()
+    probe = X0[:16]
+    stop = threading.Event()
+    failures: list = []
+    latencies: list = []
+
+    def client(tid: int) -> None:
+        i = tid
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                fleet.predict(X0[i % 512], timeout=20.0)
+                latencies.append(time.perf_counter() - t0)
+            except Exception as e:
+                failures.append(repr(e))
+            i += 4
+
+    def wait_for(pred, what, timeout=60.0):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if pred():
+                return True
+            time.sleep(0.01)
+        raise RuntimeError(f"continual_learning bench: timed out on {what}")
+
+    refresh_wall = []
+    t_start = time.perf_counter()
+    with fleet:
+        clients = [
+            threading.Thread(target=client, args=(t,), daemon=True)
+            for t in range(4)
+        ]
+        for t in clients:
+            t.start()
+        daemon = TrainerDaemon(
+            fleet, log,
+            poll_interval_s=0.01, refit_interval_s=0.05,
+            min_refit_chunks=2,
+            canary_fraction=1.0, canary_batches=2, canary_timeout_s=10.0,
+            canary_atol=0.5, canary_rtol=0.5,
+            max_batch_retries=0,
+        )
+        with daemon:
+            # refreshes 1-2: plain promotes under load
+            for b in range(2):
+                t0 = time.perf_counter()
+                for j in range(2):
+                    X, Y = make(chunk_rows, 200 + 10 * b + j)
+                    log.append(X, Y)
+                wait_for(
+                    lambda want=b + 1: fleet.metrics.count("refits") >= want,
+                    f"refresh {b + 1}",
+                )
+                refresh_wall.append(time.perf_counter() - t0)
+
+            # refresh 3: kill replica 1 INSIDE the open canary window
+            # (a wide window so promotion cannot outrun the kill)
+            daemon.canary_batches = 32
+            t0 = time.perf_counter()
+            for j in range(2):
+                X, Y = make(chunk_rows, 230 + j)
+                log.append(X, Y)
+            wait_for(
+                lambda: any(r._shadow is not None for r in fleet.replicas),
+                "canary window open", timeout=30.0,
+            )
+            kill_in_window = any(
+                r._shadow is not None for r in fleet.replicas
+            )
+            faults.install(faults.parse_plan("replica.batch#1=kill@0"))
+            wait_for(
+                lambda: fleet.metrics.count("restarts") >= 1,
+                "supervised replica restart",
+            )
+            skew_mid = fleet.version_report()["skew"]
+            wait_for(
+                lambda: fleet.metrics.count("refits") >= 3, "refresh 3"
+            )
+            refresh_wall.append(time.perf_counter() - t0)
+            faults.clear()
+            daemon.canary_batches = 2
+
+            # the injected bad refresh: poisoned batch must roll back
+            pre = np.asarray(
+                [fleet.predict(row, timeout=20.0) for row in probe]
+            )
+            for _ in range(2):
+                log.append(
+                    np.full((chunk_rows, d), 1e4, np.float32),
+                    np.full((chunk_rows, 3), -1e4, np.float32),
+                )
+            wait_for(
+                lambda: fleet.metrics.count("rollbacks") >= 1
+                and daemon.parked_batches,
+                "rollback + park",
+            )
+            post = np.asarray(
+                [fleet.predict(row, timeout=20.0) for row in probe]
+            )
+            parked = daemon.parked_batches
+        stop.set()
+        for t in clients:
+            t.join(timeout=10)
+        snap = fleet.metrics.snapshot()
+        version_report = fleet.version_report()
+    wall = time.perf_counter() - t_start
+
+    c = snap["counters"]
+    refits = c.get("refits", 0)
+    bitequal = bool(np.array_equal(pre, post))
+    # every appended chunk folded exactly once, whole run (3 promoted
+    # batches + 1 parked batch = 8 chunks)
+    scan_ok = log.production_counts == {i: 1 for i in range(8)}
+    zero_failed = (
+        not failures and c.get("completed", 0) == c.get("submitted", 0)
+    )
+    lat_sorted = sorted(latencies)
+    p99 = lat_sorted[int(len(lat_sorted) * 0.99) - 1] if lat_sorted else None
+    return {
+        "gates": {
+            "zero_failed_requests_ok": bool(zero_failed),
+            "refreshes_ok": bool(
+                refits >= 3
+                and version_report["version"] == refits + 1
+                and not version_report["skew"]
+            ),
+            "rollback_bitequal_ok": bool(
+                c.get("rollbacks", 0) >= 1 and parked and bitequal
+            ),
+            "replica_kill_ok": bool(
+                c.get("restarts", 0) >= 1 and not skew_mid
+            ),
+            "absorb_scan_count_ok": bool(scan_ok),
+        },
+        "traffic": {
+            "completed": c.get("completed", 0),
+            "failures": len(failures),
+            "p50_s": round(lat_sorted[len(lat_sorted) // 2], 4)
+            if lat_sorted else None,
+            "p99_s": round(p99, 4) if p99 is not None else None,
+            "wall_seconds": round(wall, 2),
+        },
+        "loop": {
+            "refreshes_promoted": refits,
+            "rollbacks": c.get("rollbacks", 0),
+            "parked_batches": list(parked),
+            "restarts": c.get("restarts", 0),
+            "kill_during_canary_window": bool(kill_in_window),
+            "refresh_wall_seconds": [round(s, 3) for s in refresh_wall],
+            "absorbed_chunks": c.get("absorbed_chunks", 0),
+            "absorbed_rows": c.get("absorbed_rows", 0),
+            "chunk_production_counts": dict(log.production_counts),
+            "final_version": version_report["version"],
+        },
+    }
+
+
 def _section(name, fn):
     """Run one bench section with stderr progress (stdout stays pure JSON)."""
     import sys
@@ -3508,6 +3707,9 @@ def main() -> int:
     weak_scaling = _section("weak_scaling", bench_weak_scaling)
     sharded_scan = _section("sharded_scan", bench_sharded_scan)
     fault_tolerance = _section("fault_tolerance", bench_fault_tolerance)
+    continual_learning = _section(
+        "continual_learning", bench_continual_learning
+    )
     from keystone_tpu.obs import tracer as trace_mod
 
     tracer = trace_mod.current()
@@ -3555,6 +3757,7 @@ def main() -> int:
                     "weak_scaling_virtual_mesh": weak_scaling,
                     "sharded_scan": sharded_scan,
                     "fault_tolerance": fault_tolerance,
+                    "continual_learning": continual_learning,
                     "trace": trace_extra,
                 },
             }

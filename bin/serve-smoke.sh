@@ -20,6 +20,11 @@
 # manifest over the filesystem) and serve >= 1 micro-batch, with every
 # response matching (--expect-zero-compiles + the demo's per-worker
 # batch assertion make either failure fatal).
+# Boot 6 closes the continual-learning loop: a fleet + trainer daemon
+# (keystone_tpu/trainer/) with live traffic while chunk batches append —
+# every good batch must canary-pass and PROMOTE a refreshed model, the
+# poisoned batch must canary-FAIL, roll back, and be parked, and not one
+# request may fail (the demo exits nonzero on any of it).
 # Extra flags pass through to the demo, e.g.:
 #   bin/serve-smoke.sh --requests 128 --buckets 8,32,64
 set -euo pipefail
@@ -90,3 +95,5 @@ print(
 PY
 echo "== boot 5 (router + 2 worker processes, warm: zero compiles in every worker) =="
 "${run[@]}" --workers 2 --expect-zero-compiles "$@"
+echo "== boot 6 (continual learning: trainer daemon promotes refreshes, rolls back the poisoned batch) =="
+env JAX_PLATFORMS=cpu python -m keystone_tpu --trainer-demo --backend cpu

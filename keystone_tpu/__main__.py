@@ -141,25 +141,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     # positional, and the demo's own flags (--requests 64, ...) must pass
     # through parse_known_args without a positional slot swallowing their
     # values. Accept the same unambiguous prefix abbreviations argparse
-    # would (--serve, --sweep-d, ...); a prefix of BOTH demo flags
-    # (--s, --sw is fine, --s is not) matches neither and falls through
-    # to argparse's ambiguity error.
-    def _is_demo_flag(a: str, flag: str, other: str) -> bool:
+    # would (--serve, --train, --sweep-d, ...); a prefix shared with ANY
+    # other registered flag (--s, --tra vs --trace) matches no demo flag
+    # and falls through to argparse's ambiguity error.
+    _DEMO_FLAGS = ("--serve-demo", "--sweep-demo", "--trainer-demo")
+    #: every other long option registered below — a demo abbreviation
+    #: must be unambiguous against these too, exactly as argparse would
+    #: treat it (--tra must stay an error between --trace/--trainer-demo)
+    _OTHER_FLAGS = (
+        "--backend", "--cpuDevices", "--log", "--logLevel", "--profile",
+        "--check", "--trace", "--aot-cache", "--profiles",
+    )
+
+    def _is_demo_flag(a: str, flag: str) -> bool:
         return (
-            len(a) > 2 and flag.startswith(a) and not other.startswith(a)
+            len(a) > 2
+            and flag.startswith(a)
+            and sum(f.startswith(a) for f in _DEMO_FLAGS) == 1
+            and not any(f.startswith(a) for f in _OTHER_FLAGS)
         )
 
     def _is_serve_demo_flag(a: str) -> bool:
-        return _is_demo_flag(a, "--serve-demo", "--sweep-demo")
+        return _is_demo_flag(a, "--serve-demo")
 
     def _is_sweep_demo_flag(a: str) -> bool:
-        return _is_demo_flag(a, "--sweep-demo", "--serve-demo")
+        return _is_demo_flag(a, "--sweep-demo")
+
+    def _is_trainer_demo_flag(a: str) -> bool:
+        return _is_demo_flag(a, "--trainer-demo")
 
     serve_demo = any(_is_serve_demo_flag(a) for a in argv)
     sweep_demo = any(_is_sweep_demo_flag(a) for a in argv)
+    trainer_demo = any(_is_trainer_demo_flag(a) for a in argv)
     argv = [
         a for a in argv
-        if not (_is_serve_demo_flag(a) or _is_sweep_demo_flag(a))
+        if not any(_is_demo_flag(a, f) for f in _DEMO_FLAGS)
     ]
     # registered for -h only; the flags themselves are consumed above
     p.add_argument(
@@ -180,7 +196,16 @@ def main(argv: Optional[List[str]] = None) -> int:
              "member, and hot-swap it into a live serving engine; "
              "replaces the pipeline name",
     )
-    if not (serve_demo or sweep_demo):
+    p.add_argument(
+        "--trainer-demo", action="store_true", dest="trainer_demo",
+        help="smoke mode: the closed continual-learning loop "
+             "(keystone_tpu/trainer/) — boot a replica fleet + trainer "
+             "daemon, append chunk batches under live traffic, and "
+             "assert promoted refreshes, a clean canary rollback of a "
+             "poisoned batch, and zero request failures; replaces the "
+             "pipeline name",
+    )
+    if not (serve_demo or sweep_demo or trainer_demo):
         # validated by _resolve_pipeline, not choices=, so shorthand
         # aliases (mnist, cifar, ...) and any-case names resolve
         p.add_argument(
@@ -237,7 +262,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              "(also: KEYSTONE_PROFILE_DIR=DIR)",
     )
     args, rest = p.parse_known_args(argv)
-    if not (serve_demo or sweep_demo):
+    if not (serve_demo or sweep_demo or trainer_demo):
         name = _resolve_pipeline(p, args.pipeline)
     from .utils.obs import configure, export_trace
 
@@ -260,6 +285,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .sweep.demo import main as sweep_demo_main
 
                 return sweep_demo_main(rest)
+            if trainer_demo:
+                from .trainer.demo import main as trainer_demo_main
+
+                return trainer_demo_main(rest)
             return PIPELINES[name](rest)
         except Exception as e:
             from . import check as check_mod

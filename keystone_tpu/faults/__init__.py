@@ -25,6 +25,9 @@ from .plan import (
     REPLICA_BATCH,
     SCAN_CHUNK,
     SCAN_STAGE,
+    TRAINER_ABSORB,
+    TRAINER_CANARY,
+    TRAINER_INGEST,
     WORKER_SPAWN,
     FatalFaultInjected,
     FaultInjected,
@@ -48,6 +51,9 @@ __all__ = [
     "REPLICA_BATCH",
     "SCAN_CHUNK",
     "SCAN_STAGE",
+    "TRAINER_ABSORB",
+    "TRAINER_CANARY",
+    "TRAINER_INGEST",
     "FatalFaultInjected",
     "FaultInjected",
     "FaultPlan",

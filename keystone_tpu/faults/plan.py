@@ -75,6 +75,17 @@ AOT_READ = "aot.read"
 #: one cluster worker-process spawn attempt (router side, before fork —
 #: transient => the router's spawn retry/restart budget absorbs it)
 WORKER_SPAWN = "worker.spawn"
+#: one trainer-daemon tail of the append-only chunk source (transient =>
+#: the daemon's bounded ingest retry; kill => the daemon supervisor)
+TRAINER_INGEST = "trainer.ingest"
+#: one chunk folded by a trainer absorb (fires per folded chunk, INSIDE
+#: the checkpointed fold — a kill here leaves the last completed block
+#: on disk, so the retried absorb resumes instead of rescanning)
+TRAINER_ABSORB = "trainer.absorb"
+#: one trainer canary attempt, before the fleet swap is entered
+#: (transient => counted as canary evidence failure: rollback + bounded
+#: batch retry, old model keeps serving)
+TRAINER_CANARY = "trainer.canary"
 
 _KINDS = ("transient", "fatal", "kill")
 

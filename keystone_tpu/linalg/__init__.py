@@ -16,13 +16,20 @@ from .bcd import (
     stream_column_means,
 )
 from .tsqr import tsqr_r, tsqr_r_streaming
-from .accumulators import GramSolverState, MomentsState, TsqrRState
-from .weighted import solve_weighted_streaming
+from .accumulators import (
+    GramSolverState,
+    MomentsState,
+    NotAbsorbable,
+    TsqrRState,
+)
+from .weighted import WeightedSolverState, solve_weighted_streaming
 
 __all__ = [
     "GramSolverState",
     "MomentsState",
+    "NotAbsorbable",
     "TsqrRState",
+    "WeightedSolverState",
     "RowShardedMatrix",
     "gram",
     "cross",

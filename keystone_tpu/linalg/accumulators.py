@@ -42,6 +42,15 @@ def _np(x) -> np.ndarray:
     return np.asarray(x)
 
 
+class NotAbsorbable(ValueError):
+    """This model family has no associative sufficient statistic, so
+    appended chunks cannot be folded into the fitted state — a typed
+    refusal, never a silently wrong incremental answer. Raised by the
+    BCD/iterative families (their iterates depend on visitation order)
+    and by ``FittedPipeline.absorb`` on a model fit without a
+    snapshot-able solver state."""
+
+
 @dataclass
 class GramSolverState:
     """Raw normal-equations sufficient statistics: the exact-solve
@@ -156,6 +165,28 @@ class GramSolverState:
             jnp.asarray(nu, dtype=jnp.float32),
             jnp.asarray(mu, dtype=jnp.float32),
         )
+
+    def rebuild_mapper(self, mapper):
+        """Re-solve at the recorded λ and rebuild ``mapper``'s class with
+        the updated parameters — the state-protocol hook
+        ``FittedPipeline.absorb`` calls after folding appended chunks
+        (each state family knows its own mapper constructor)."""
+        W, b, mean = self.solve(self.lam)
+        return type(mapper)(
+            W, b=b, feature_mean=mean, solver_state=self.snapshot()
+        )
+
+    def moments(self) -> "MomentsState":
+        """The column moments of everything folded so far, derived from
+        the raw sums (mean = Σa/n; M2 = diag(Σ(a−s)(a−s)ᵀ) − n·(μ−s)²) —
+        the fitted snapshot a drift monitor compares appended feature
+        chunks against without a second statistics pass."""
+        if self.gram is None or self.n == 0:
+            raise ValueError("moments of an empty GramSolverState")
+        mu = self.sum_x / float(self.n)
+        dmu = mu - self.shift.astype(np.float64)
+        m2 = np.maximum(np.diag(self.gram) - self.n * dmu * dmu, 0.0)
+        return MomentsState(n=self.n, mean=mu, m2=m2)
 
     def snapshot(self) -> "GramSolverState":
         """An independent copy with the ``rows_folded`` work counter

@@ -20,13 +20,16 @@ lowering (see the measurement in ``nodes/learning/weighted.py``).
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..data.pipeline_scan import scan_pipeline
 from ..parallel.mesh import shard_classes
+from .accumulators import MomentsState, _np
 
 
 @jax.jit
@@ -632,3 +635,230 @@ def _solve_weighted_streaming_lanes(
         jnp.einsum("cd,dc->c", stats[j][2], Ws[j]) for j in range(nblocks)
     )
     return Ws, b
+
+
+# -- snapshot-able per-class accumulators (incremental refit) -----------------
+
+
+@jax.jit
+def _weighted_chunk_stats(Xs, Y):
+    """One chunk's per-class raw statistics (shift already subtracted):
+    gram Σ(x−s)(x−s)ᵀ, per-class grams, label cross terms, per-class
+    sums — the associative pieces :class:`WeightedSolverState` folds.
+    One jitted program per chunk shape; everything here is f32-true
+    GEMM work against the provisional shift (same policy as
+    ``GramSolverState.update``)."""
+    with jax.default_matmul_precision("highest"):
+        k = Y.shape[1]
+        y_idx = jnp.argmax(Y, axis=1)
+        oh = jax.nn.one_hot(y_idx, k, dtype=Xs.dtype)          # (rows, k)
+        ohy = oh * Y                                           # (rows, k)
+        return (
+            jnp.matmul(Xs.T, Xs),            # gram_s   (d, d)
+            jnp.einsum("nd,nc,ne->cde", Xs, oh, Xs),  # class_gram_s (k, d, d)
+            jnp.matmul(Xs.T, Y),             # cross_s  (d, k)
+            jnp.matmul(ohy.T, Xs),           # class_cross_s (k, d)
+            jnp.sum(Xs, axis=0),             # sum_dx   (d,)
+            jnp.matmul(oh.T, Xs),            # class_sum_dx (k, d)
+            jnp.sum(Y, axis=0),              # sum_y    (k,)
+            jnp.sum(ohy, axis=0),            # class_sum_y (k,)
+            jnp.sum(oh, axis=0),             # counts   (k,)
+        )
+
+
+@dataclass
+class WeightedSolverState:
+    """Per-class sufficient statistics of the EXACT class-weighted
+    mixture ridge — the weighted family's snapshot-able accumulator
+    (``FittedPipeline.absorb`` beyond the Gram family).
+
+    For every class c the per-class oracle solves
+    ``(Σᵢ bᵢ(xᵢ−μ_c)(xᵢ−μ_c)ᵀ + λI) W_c = Σᵢ bᵢ(xᵢ−μ_c)(y_ic − m_c)``
+    with sample weights ``bᵢ = (1−w)/n + w·1[i∈c]/n_c``, joint mean
+    ``μ_c = w·mean_c + (1−w)·mean`` and joint label mean ``m_c``
+    (``nodes/learning/weighted.py::PerClassWeightedLeastSquares
+    Estimator``). Every term is a linear/bilinear functional of the row
+    stream, so the whole solve is derivable from raw per-class sums that
+    are ASSOCIATIVE over row blocks: the population Gram, one (k, d, d)
+    per-class Gram stack, the label cross terms, and the per-class
+    count/sum vectors. Appended chunks fold in O(new chunks); the k
+    solves are O(k·d³) with no data pass.
+
+    The BCD-iterated families (block-weighted, reweighted) have NO such
+    statistic — their iterates depend on block visitation order — and
+    raise the typed :class:`~keystone_tpu.linalg.accumulators.
+    NotAbsorbable` instead of silently refitting wrong.
+
+    Accumulation discipline mirrors :class:`~keystone_tpu.linalg.
+    accumulators.GramSolverState`: host float64 totals, per-chunk f32
+    products on device against a provisional first-chunk shift s (the
+    centered quantities are re-derived algebraically at solve time, so
+    the class means may keep moving as chunks arrive). Memory is
+    O(k·d²) — the price of k per-class Grams; the Gram-family state
+    stays the right choice when k·d² won't sit in host RAM.
+    """
+
+    #: the mixture/ridge identity the owning model was solved with —
+    #: what ``FittedPipeline.absorb`` re-solves at
+    lam: float = 0.0
+    mixture_weight: float = 0.5
+    #: block split of the rebuilt ``BlockLinearMapper`` (0 = one block)
+    block_size: int = 0
+    n: int = 0
+    counts: Optional[np.ndarray] = None          # (k,)
+    shift: Optional[np.ndarray] = None           # (d,) f32 provisional
+    sum_dx: Optional[np.ndarray] = None          # (d,)   Σ (x−s)
+    class_sum_dx: Optional[np.ndarray] = None    # (k, d) Σ_{i∈c} (x−s)
+    sum_y: Optional[np.ndarray] = None           # (k,)   Σ y
+    class_sum_y: Optional[np.ndarray] = None     # (k,)   Σ_{i∈c} y_ic
+    gram_s: Optional[np.ndarray] = None          # (d, d)
+    class_gram_s: Optional[np.ndarray] = None    # (k, d, d)
+    cross_s: Optional[np.ndarray] = None         # (d, k) Σ (x−s) yᵀ
+    class_cross_s: Optional[np.ndarray] = None   # (k, d) Σ_{i∈c} (x−s) y_ic
+    #: rows folded since construction OR the last snapshot() — the
+    #: O(new chunks) work gate reads this, not ``n``
+    rows_folded: int = field(default=0, compare=False)
+
+    @property
+    def d(self) -> int:
+        return 0 if self.gram_s is None else int(self.gram_s.shape[0])
+
+    @property
+    def k(self) -> int:
+        return 0 if self.cross_s is None else int(self.cross_s.shape[1])
+
+    def update(self, A_chunk, y_chunk) -> "WeightedSolverState":
+        """Fold one (rows, d) feature chunk and its (rows, k) class-
+        indicator slice (class = argmax of the row, the convention of
+        the whole weighted family)."""
+        A = jnp.asarray(A_chunk, dtype=jnp.float32)
+        Y = jnp.asarray(y_chunk, dtype=jnp.float32)
+        if A.ndim != 2 or Y.ndim != 2:
+            raise ValueError(
+                f"chunks must be 2-D (A: {A.shape}, y: {Y.shape})"
+            )
+        if A.shape[0] != Y.shape[0]:
+            raise ValueError(
+                f"feature chunk has {A.shape[0]} rows, labels {Y.shape[0]}"
+            )
+        rows, d = int(A.shape[0]), int(A.shape[1])
+        k = int(Y.shape[1])
+        if self.gram_s is None:
+            self.counts = np.zeros((k,), np.float64)
+            self.sum_dx = np.zeros((d,), np.float64)
+            self.class_sum_dx = np.zeros((k, d), np.float64)
+            self.sum_y = np.zeros((k,), np.float64)
+            self.class_sum_y = np.zeros((k,), np.float64)
+            self.gram_s = np.zeros((d, d), np.float64)
+            self.class_gram_s = np.zeros((k, d, d), np.float64)
+            self.cross_s = np.zeros((d, k), np.float64)
+            self.class_cross_s = np.zeros((k, d), np.float64)
+            self.shift = _np(jnp.mean(A, axis=0)).astype(np.float32)
+        elif d != self.d or k != self.k:
+            raise ValueError(
+                f"chunk shape ({d}, {k}) does not match accumulated "
+                f"({self.d}, {self.k})"
+            )
+        parts = _weighted_chunk_stats(A - jnp.asarray(self.shift), Y)
+        (g, cg, cr, ccr, sdx, csdx, sy, csy, cnt) = (
+            _np(p).astype(np.float64) for p in parts
+        )
+        self.gram_s += g
+        self.class_gram_s += cg
+        self.cross_s += cr
+        self.class_cross_s += ccr
+        self.sum_dx += sdx
+        self.class_sum_dx += csdx
+        self.sum_y += sy
+        self.class_sum_y += csy
+        self.counts += cnt
+        self.n += rows
+        self.rows_folded += rows
+        return self
+
+    def solve(self, lam: Optional[float] = None):
+        """``(W (d, k), b (k,))`` of the exact per-class mixture ridge
+        from the CURRENT accumulated state — O(k·d³), no data pass. The
+        centering algebra happens here in float64: with δ_c = μ_c − s,
+        ``G_c = (1−w)/n·Σ(x−s)(x−s)ᵀ + w/n_c·Σ_{i∈c}(x−s)(x−s)ᵀ − δ_cδ_cᵀ``
+        and ``rhs_c = (1−w)/n·Σ(x−s)y_c + w/n_c·Σ_{i∈c}(x−s)y_ic − m_c·δ_c``
+        (both follow from Σᵢbᵢ = 1 and Σᵢbᵢ(x−s) = δ_c)."""
+        if self.gram_s is None or self.n == 0:
+            raise ValueError("solve of an empty WeightedSolverState")
+        lam = self.lam if lam is None else float(lam)
+        w = float(self.mixture_weight)
+        n = float(self.n)
+        d, k = self.d, self.k
+        s = self.shift.astype(np.float64)
+        safe = np.maximum(self.counts, 1.0)
+        pop_mean = s + self.sum_dx / n
+        class_means = s[None, :] + self.class_sum_dx / safe[:, None]
+        joint_means = w * class_means + (1 - w) * pop_mean[None, :]
+        jlm = (1 - w) * self.sum_y / n + w * self.class_sum_y / safe
+        eye = np.eye(d)
+        cols = []
+        for c in range(k):
+            delta = joint_means[c] - s
+            Gmix = (
+                (1 - w) / n * self.gram_s
+                + w / safe[c] * self.class_gram_s[c]
+            )
+            G = Gmix - np.outer(delta, delta)
+            rhs = (
+                (1 - w) / n * self.cross_s[:, c]
+                + w / safe[c] * self.class_cross_s[c]
+                - jlm[c] * delta
+            )
+            cols.append(np.linalg.solve(G + lam * eye, rhs))
+        W = np.stack(cols, axis=1)  # (d, k)
+        b = jlm - np.einsum("cd,dc->c", joint_means, W)
+        return (
+            jnp.asarray(W, dtype=jnp.float32),
+            jnp.asarray(b, dtype=jnp.float32),
+        )
+
+    def rebuild_mapper(self, mapper):
+        """Re-solve and rebuild the fitted ``BlockLinearMapper`` at the
+        recorded block split — the absorb state-protocol hook."""
+        W, b = self.solve()
+        d = int(W.shape[0])
+        bs = self.block_size or d
+        blocks = [W[i : min(i + bs, d)] for i in range(0, d, bs)]
+        return type(mapper)(
+            blocks, bs, b=b, solver_state=self.snapshot()
+        )
+
+    def moments(self) -> MomentsState:
+        """Column moments of every row folded so far (same derivation as
+        ``GramSolverState.moments``) — the drift-monitor baseline."""
+        if self.gram_s is None or self.n == 0:
+            raise ValueError("moments of an empty WeightedSolverState")
+        mu = self.shift.astype(np.float64) + self.sum_dx / float(self.n)
+        dmu = mu - self.shift.astype(np.float64)
+        m2 = np.maximum(np.diag(self.gram_s) - self.n * dmu * dmu, 0.0)
+        return MomentsState(n=self.n, mean=mu, m2=m2)
+
+    def snapshot(self) -> "WeightedSolverState":
+        """Independent copy with the ``rows_folded`` work counter zeroed
+        (the absorb contract, same as ``GramSolverState.snapshot``)."""
+
+        def cp(a):
+            return None if a is None else a.copy()
+
+        return WeightedSolverState(
+            lam=self.lam,
+            mixture_weight=self.mixture_weight,
+            block_size=self.block_size,
+            n=self.n,
+            counts=cp(self.counts),
+            shift=cp(self.shift),
+            sum_dx=cp(self.sum_dx),
+            class_sum_dx=cp(self.class_sum_dx),
+            sum_y=cp(self.sum_y),
+            class_sum_y=cp(self.class_sum_y),
+            gram_s=cp(self.gram_s),
+            class_gram_s=cp(self.class_gram_s),
+            cross_s=cp(self.cross_s),
+            class_cross_s=cp(self.class_cross_s),
+            rows_folded=0,
+        )

@@ -260,10 +260,19 @@ class BlockLinearMapper(Transformer):
     GEMM (parity: BlockLinearMapper.scala:22-98, whose per-block RDD zip+sum
     is pure network choreography the MXU doesn't need)."""
 
+    #: refit bookkeeping (a snapshot-able WeightedSolverState from the
+    #: per-class weighted family), never part of the serve computation
+    aot_fingerprint_exclude = ("solver_state",)
+
     def __init__(self, xs: Sequence, block_size: int, b=None,
-                 feature_means: Optional[Sequence] = None):
+                 feature_means: Optional[Sequence] = None,
+                 solver_state=None):
         import numpy as np
 
+        #: optional :class:`~keystone_tpu.linalg.weighted.
+        #: WeightedSolverState` captured at fit time — what
+        #: ``FittedPipeline.absorb`` folds appended chunks into
+        self.solver_state = solver_state
         # One batched device fetch; parameters live on host (utils/params.py)
         xs, b, feature_means = jax.device_get((list(xs), b, feature_means))
         self.xs = [as_param(x) for x in xs]
@@ -311,7 +320,18 @@ class BlockLeastSquaresEstimator(LabelEstimator, CostModel):
     supports_streaming = True
 
     def __init__(self, block_size: int, num_iter: int, lam: float = 0.0,
-                 num_features: Optional[int] = None):
+                 num_features: Optional[int] = None,
+                 snapshot: bool = False):
+        if snapshot:
+            from ...linalg.accumulators import NotAbsorbable
+
+            raise NotAbsorbable(
+                "block-coordinate descent has no snapshot-able state: "
+                "its iterates depend on block visitation order, so "
+                "appended chunks cannot be folded in after the fact — "
+                "fit with LinearMapEstimator(snapshot=True) (exact Gram "
+                "family) for an absorbable model"
+            )
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam

@@ -132,7 +132,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator, CostModel):
     def __init__(self, block_size: int, num_iter: int, lam: float,
                  mixture_weight: float,
                  num_features: Optional[int] = None,
-                 class_chunk: int = 8):
+                 class_chunk: int = 8,
+                 snapshot: bool = False):
+        if snapshot:
+            from ...linalg.accumulators import NotAbsorbable
+
+            raise NotAbsorbable(
+                "the block-weighted BCD solver has no snapshot-able "
+                "state: its iterates depend on block visitation order, "
+                "so appended chunks cannot be folded in after the fact "
+                "— fit with PerClassWeightedLeastSquaresEstimator("
+                "snapshot=True) for an absorbable weighted model"
+            )
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
@@ -411,16 +422,27 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator, CostModel):
     ridge — the reference uses it as the agreement oracle for the block
     solver (parity: PerClassWeightedLeastSquares.scala:31-63;
     BlockWeightedLeastSquaresSuite.scala:115). Exact (non-iterative) when
-    the full feature matrix fits; use for tests/small problems."""
+    the full feature matrix fits; use for tests/small problems.
+
+    ``snapshot=True`` fits through the per-class raw accumulators
+    (:class:`~keystone_tpu.linalg.weighted.WeightedSolverState` — k
+    per-class Grams plus label cross terms, all associative over row
+    blocks) and attaches the state to the fitted mapper, so
+    ``FittedPipeline.absorb`` can fold appended chunks into the weighted
+    family exactly as it does the Gram family. The exact per-class
+    solve is order-free, which is WHY this family absorbs while the
+    BCD-iterated weighted solvers raise :class:`NotAbsorbable`."""
 
     def __init__(self, block_size: int, num_iter: int, lam: float,
                  mixture_weight: float,
-                 num_features: Optional[int] = None):
+                 num_features: Optional[int] = None,
+                 snapshot: bool = False):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
         self.mixture_weight = mixture_weight
         self.num_features = num_features
+        self.snapshot = snapshot
 
     def cost(self, n, d, k, sparsity, num_machines,
              cpu_weight, mem_weight, network_weight):
@@ -437,8 +459,55 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator, CostModel):
             cpu_weight, mem_weight, network_weight,
         )
 
+    def _fit_snapshot(self, data, labels: Dataset) -> BlockLinearMapper:
+        """The accumulator path: fold the data (chunked or not) into a
+        :class:`~keystone_tpu.linalg.weighted.WeightedSolverState`, solve
+        from the state, and attach the snapshot for later ``absorb``. The
+        state solves in host float64, so this path is if anything MORE
+        accurate than the f32 dense oracle it mirrors."""
+        from ...data.chunked import ChunkedDataset
+        from ...linalg.weighted import WeightedSolverState
+
+        d_cap = self.num_features
+        state = WeightedSolverState(
+            lam=float(self.lam),
+            mixture_weight=float(self.mixture_weight),
+            block_size=int(self.block_size),
+        )
+        Y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
+        if isinstance(data, ChunkedDataset):
+            offset = 0
+            for chunk in data.raw_chunks():
+                chunk = jnp.asarray(chunk, dtype=jnp.float32)
+                if d_cap is not None:
+                    chunk = chunk[..., :d_cap]
+                rows = int(chunk.shape[0])
+                state.update(chunk, Y[offset : offset + rows])
+                offset += rows
+            if offset != int(Y.shape[0]):
+                raise ValueError(
+                    f"chunked features have {offset} rows, labels "
+                    f"{Y.shape[0]}"
+                )
+        else:
+            X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
+            if d_cap is not None:
+                X = X[:, :d_cap]
+            state.update(X, Y)
+        W, b = state.solve()
+        d = int(W.shape[0])
+        blocks = [
+            W[i : min(i + self.block_size, d)]
+            for i in range(0, d, self.block_size)
+        ]
+        return BlockLinearMapper(
+            blocks, self.block_size, b=b, solver_state=state.snapshot()
+        )
+
     @_f32_true
     def fit(self, data, labels: Dataset) -> BlockLinearMapper:
+        if self.snapshot:
+            return self._fit_snapshot(data, labels)
         X = jnp.asarray(Dataset.of(data).to_array(), dtype=jnp.float32)
         Y = jnp.asarray(Dataset.of(labels).to_array(), dtype=jnp.float32)
         w = self.mixture_weight

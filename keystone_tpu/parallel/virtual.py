@@ -29,6 +29,18 @@ def provision_virtual_devices(n_devices: int) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     flags = " ".join(f for f in flags.split() if _COUNT_FLAG not in f)
+    # The XLA:CPU thunk runtime (default since jaxlib 0.4.32) can
+    # deadlock inside sharded executables whose collectives rendezvous
+    # across MANY virtual devices oversubscribed onto FEW cores — seen
+    # here as the tier-1 suite hanging forever inside the BCD block
+    # update's psum on the 8-device mesh (ordering-sensitive: which
+    # programs compiled beforehand changes whether it fires; the same
+    # fragility bcd.py's donation note records as intermittent aborts).
+    # The virtual mesh is exactly the oversubscribed configuration, so
+    # provisioning opts back into the legacy runtime; real-accelerator
+    # paths never pass through here. An explicit user-set value wins.
+    if "xla_cpu_use_thunk_runtime" not in flags:
+        flags = f"{flags} --xla_cpu_use_thunk_runtime=false"
     os.environ["XLA_FLAGS"] = (
         flags + f" --{_COUNT_FLAG}={n_devices}"
     ).strip()

@@ -175,6 +175,18 @@ class ServingFleet:
             )
             for i in range(n)
         ]
+        # the PUBLISHED model: version/digest/executable every replica
+        # must serve. A restarted replica is re-pinned to this — so a
+        # canary window that outlives a replica restart can never leak
+        # the candidate (or anything else) onto the fresh thread, and a
+        # long rollout ends with zero version skew. Guarded by
+        # _supervise_lock: the supervisor re-pins from the dying
+        # replica's thread, which must not take the lifecycle lock.
+        self._model_version = 1
+        self._model_digest = getattr(compiled, "digest", None)
+        self._published_exec = compiled
+        for rep in self._replicas:
+            rep.version = self._model_version
         self._scheduler = FleetScheduler(
             n,
             self._policy,
@@ -230,6 +242,42 @@ class ServingFleet:
         """``(shape, dtype)`` of every trace the fleet paid, in compile
         order — len() equals the ``compiles`` counter."""
         return list(self._compiled_signatures)
+
+    @property
+    def fitted(self) -> FittedPipeline:
+        """The currently-published model (the trainer daemon's absorb
+        base — it moves only on a promoted swap)."""
+        return self._fitted
+
+    @property
+    def model_version(self) -> int:
+        """The published model version: 1 at boot, +1 per promoted swap."""
+        with self._supervise_lock:
+            return self._model_version
+
+    def version_report(self) -> dict:
+        """Per-replica version pinning state for long rollouts: the
+        published ``version``/``digest`` plus what each replica is
+        actually serving. ``skew`` is True when any replica disagrees
+        with the published version — transiently possible only inside a
+        promotion flip; a steady-state True means a pinning bug."""
+        with self._supervise_lock:
+            replicas = {
+                rep.index: {
+                    "version": rep.version,
+                    "restarts": self._restart_counts[rep.index],
+                }
+                for rep in self._replicas
+            }
+            return {
+                "version": self._model_version,
+                "digest": self._model_digest,
+                "replicas": replicas,
+                "skew": any(
+                    row["version"] != self._model_version
+                    for row in replicas.values()
+                ),
+            }
 
     # -- lifecycle -------------------------------------------------------
 
@@ -426,6 +474,13 @@ class ServingFleet:
                 self._restart_counts[rep.index] = used + 1
                 self._metrics.inc("restarts")
                 rep.consecutive_failures = 0
+                # re-pin to the PUBLISHED model: a restart during a
+                # canary window (or any long rollout) must come back on
+                # the version the fleet is actually serving — promotion,
+                # which flips every replica under this same lock, is the
+                # only thing that moves it forward
+                rep.flip(self._published_exec)
+                rep.version = self._model_version
             elif not self._scheduler.any_active():
                 failed = self._scheduler.fail_remaining(
                     "every replica is down and the restart budget is "
@@ -649,9 +704,19 @@ class ServingFleet:
             # no quiesce step and none is needed — run_batch reads the
             # executable reference ONCE per batch, so each in-flight
             # batch finishes whole on whichever executable it dispatched
-            # with; the flip is one atomic store per replica.
-            for rep in self._replicas:
-                rep.flip(candidate)
+            # with; the flip is one atomic store per replica. The
+            # published version advances FIRST under the supervise lock,
+            # so a replica restart racing the flip loop re-pins to the
+            # candidate and the loop's own flip is then a no-op — either
+            # order ends with every replica on the new version.
+            with self._supervise_lock:
+                self._model_version += 1
+                self._model_digest = getattr(candidate, "digest", None)
+                self._published_exec = candidate
+                version = self._model_version
+                for rep in self._replicas:
+                    rep.flip(candidate)
+                    rep.version = version
             self._fitted = fitted
             self._metrics.inc("swaps")
             report = {
@@ -660,6 +725,7 @@ class ServingFleet:
                 "compiles": self._metrics.count("compiles") - compiles_before,
                 "aot_loads": self._metrics.count("aot_loads") - loads_before,
                 "canary": canary_report,
+                "version": version,
             }
             tracer = _trace_current()
             if tracer is not None:
@@ -667,6 +733,7 @@ class ServingFleet:
                     "serve.swap",
                     op_type="ServingFleet",
                     replicas=len(self._replicas),
+                    version=version,
                     buckets_warmed=warmed,
                     compiles=report["compiles"],
                     aot_loads=report["aot_loads"],

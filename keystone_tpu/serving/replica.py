@@ -284,6 +284,11 @@ class Replica:
         #: the batch currently executing, for the fleet's shutdown path
         #: to requeue/fail if this worker wedges (None between batches)
         self.current_batch: Optional[list] = None
+        #: monotonically-increasing model version this replica serves,
+        #: stamped by the fleet at construction and on every flip — the
+        #: skew-detection surface for long rollouts (a restarted replica
+        #: is re-pinned to the PUBLISHED version until promotion)
+        self.version: int = 0
 
     @property
     def compiled(self) -> Callable:

@@ -1,0 +1,35 @@
+"""Closed-loop continual learning: the supervised trainer daemon.
+
+The robustness capstone over PRs 9-11: ``FittedPipeline.absorb`` folds
+appended chunks in O(new), the fleet does canaried zero-downtime swaps,
+and ``keystone_tpu/faults/`` provides seeded chaos + checkpoint/resume —
+this package connects them into one hands-free loop that keeps serving
+correctly while everything around it churns or fails.
+
+* :class:`ChunkLog` (:mod:`.source`) — the append-only chunk feed the
+  daemon tails;
+* :class:`DriftMonitor` (:mod:`.drift`) — moment-shift and residual
+  triggers against the fitted solver state's own moment snapshot;
+* :class:`TrainerDaemon` (:mod:`.daemon`) — the supervised loop:
+  tail → decide (cadence/drift) → checkpointed absorb → canary swap →
+  promote or roll back, with chunk-batch quarantine and explicit
+  restart budgets. Fault sites ``trainer.ingest`` / ``trainer.absorb``
+  / ``trainer.canary`` ride the ``KEYSTONE_FAULTS`` plan so every
+  failure path is deterministically testable.
+
+``python -m keystone_tpu --trainer-demo`` runs the whole loop against a
+live fleet with synthetic appends (including a poisoned batch that must
+roll back).
+"""
+
+from .daemon import TrainerDaemon, TrainerStopped
+from .drift import DriftMonitor
+from .source import AppendedChunk, ChunkLog
+
+__all__ = [
+    "AppendedChunk",
+    "ChunkLog",
+    "DriftMonitor",
+    "TrainerDaemon",
+    "TrainerStopped",
+]

@@ -986,36 +986,19 @@ class FittedPipeline(Chainable):
             is not None
         ]
 
-    def absorb(self, new_data: Any, new_labels: Any) -> "FittedPipeline":
-        """Fold appended training chunks into the fitted model WITHOUT a
-        from-scratch refit.
-
-        The terminal solver must have been fit with a snapshot-able
-        accumulator (``LinearMapEstimator(snapshot=True)`` or any sweep
-        Gram-family member): its saved
-        :class:`~keystone_tpu.linalg.accumulators.GramSolverState` holds
-        the raw Gram/cross/mean sums of everything seen so far, so the
-        update is (a) featurize ONLY the new chunks through this
-        pipeline's frozen prefix, (b) fold them into the accumulators,
-        (c) re-solve at the recorded λ — O(new chunks + d³) total. The
-        old training data is never touched.
-
-        Upstream fitted transformers (scalers, PCA, ...) stay FROZEN:
-        refitting them would change the featurization of every
-        previously-absorbed row, which only a full refit can do
-        consistently. Returns a NEW FittedPipeline (this one is
-        unchanged) — publish it to a live engine with
-        ``ServingEngine.swap``.
-        """
-        from ..data.chunked import ChunkedDataset
-        from ..data.dataset import Dataset as _Dataset
+    def _absorb_node(self):
+        """The unique solver-state node absorb folds into, or a typed
+        refusal. Returns ``(node, mapper)``."""
+        from ..linalg.accumulators import NotAbsorbable
 
         nodes = self.absorbable_nodes()
         if not nodes:
-            raise ValueError(
+            raise NotAbsorbable(
                 "absorb needs a model fit with a snapshot-able solver "
-                "state — fit with LinearMapEstimator(snapshot=True) or a "
-                "GridSweep Gram-family member"
+                "state — fit with LinearMapEstimator(snapshot=True), "
+                "PerClassWeightedLeastSquaresEstimator(snapshot=True), "
+                "or a GridSweep Gram-family member (the BCD-iterated "
+                "families have no associative state and cannot absorb)"
             )
         if len(nodes) > 1:
             labels = [self._graph.get_operator(n).label for n in nodes]
@@ -1024,24 +1007,94 @@ class FittedPipeline(Chainable):
                 f"({', '.join(labels)})"
             )
         (node,) = nodes
-        mapper = self._graph.get_operator(node)
-        state = mapper.solver_state.snapshot()
+        return node, self._graph.get_operator(node)
 
+    def _prefix_executor(self, node, data):
+        """Executor over this pipeline's frozen prefix (everything
+        upstream of the model node), with ``data`` attached — executed
+        WITHOUT re-optimizing (same invariant as apply(): re-fusing a
+        fitted graph can change float32 program partitioning vs what
+        the solver trained on). Returns ``(executor, sink)``."""
         deps = self._graph.get_dependencies(node)
         if len(deps) != 1:
             raise ValueError(
                 f"absorb expects a single-input model node, got {len(deps)} deps"
             )
-        # featurize the NEW chunks through the frozen prefix: this
-        # pipeline's graph with a sink moved to the model's input —
-        # executed WITHOUT re-optimizing (same invariant as apply():
-        # re-fusing a fitted graph can change float32 program
-        # partitioning vs what the solver trained on)
         prefix_graph, prefix_sink = self._graph.add_sink(deps[0])
-        prefix_graph, data_id = attach_data(prefix_graph, new_data)
+        prefix_graph, data_id = attach_data(prefix_graph, data)
         prefix_graph = prefix_graph.replace_dependency(self._source, data_id)
         prefix_graph = prefix_graph.remove_source(self._source)
-        prefix_exec = GraphExecutor(prefix_graph, optimize=False)
+        return GraphExecutor(prefix_graph, optimize=False), prefix_sink
+
+    def prefix_features(self, data: Any):
+        """Run ``data`` through the frozen featurizer prefix (everything
+        upstream of the absorbable model node) and return the featurized
+        value — what the model node would see at fit time. The trainer
+        daemon's drift monitor compares these features against the
+        fitted solver state's :meth:`~keystone_tpu.linalg.accumulators.
+        GramSolverState.moments` snapshot, and applies the model mapper
+        to them for streaming residual error, without paying a full
+        pipeline apply per monitored chunk."""
+        node, _ = self._absorb_node()
+        executor, sink = self._prefix_executor(node, data)
+        return executor.execute(sink).get()
+
+    def absorb(
+        self,
+        new_data: Any,
+        new_labels: Any,
+        *,
+        checkpoint: Optional[str] = None,
+        checkpoint_key: Optional[str] = None,
+        checkpoint_every: int = 1,
+        on_chunk: Optional[Callable[[int, Any], None]] = None,
+    ) -> "FittedPipeline":
+        """Fold appended training chunks into the fitted model WITHOUT a
+        from-scratch refit.
+
+        The terminal solver must have been fit with a snapshot-able
+        accumulator (``LinearMapEstimator(snapshot=True)``, any sweep
+        Gram-family member, or the per-class weighted family's
+        ``snapshot=True``): its saved state
+        (:class:`~keystone_tpu.linalg.accumulators.GramSolverState` /
+        :class:`~keystone_tpu.linalg.weighted.WeightedSolverState`)
+        holds the raw sums of everything seen so far, so the update is
+        (a) featurize ONLY the new chunks through this pipeline's frozen
+        prefix, (b) fold them into the accumulators, (c) re-solve at the
+        recorded λ — O(new chunks + solve) total. The old training data
+        is never touched. Models without such a state raise the typed
+        :class:`~keystone_tpu.linalg.accumulators.NotAbsorbable`.
+
+        ``checkpoint`` (a directory) makes a chunked absorb RESUMABLE:
+        the folding state persists atomically every ``checkpoint_every``
+        chunks (:class:`~keystone_tpu.faults.FitCheckpoint`), so an
+        absorb killed mid-fold and retried with the same arguments
+        resumes from the last completed block — folding bit-identical
+        state — and never re-produces the already-folded prefix (the
+        trainer daemon's crash-survival contract). ``checkpoint_key``
+        overrides the identity the checkpoint is keyed by (callers that
+        retry a specific chunk batch pass a stable batch id); the
+        default derives from the base state and the appended length.
+        The checkpoint is removed when the absorb completes.
+
+        ``on_chunk(chunk_index, feat_chunk)`` runs before each chunk is
+        folded — the trainer's seam for the ``trainer.absorb`` fault
+        point and drift bookkeeping. It fires only for chunks actually
+        produced this call (a resumed absorb skips the folded prefix).
+
+        Upstream fitted transformers (scalers, PCA, ...) stay FROZEN:
+        refitting them would change the featurization of every
+        previously-absorbed row, which only a full refit can do
+        consistently. Returns a NEW FittedPipeline (this one is
+        unchanged) — publish it to a live engine with
+        ``ServingEngine.swap`` / ``ServingFleet.swap``.
+        """
+        from ..data.chunked import ChunkedDataset
+        from ..data.dataset import Dataset as _Dataset
+
+        node, mapper = self._absorb_node()
+        state = mapper.solver_state.snapshot()
+        prefix_exec, prefix_sink = self._prefix_executor(node, new_data)
 
         tracer = _trace_current()
         with contextlib.ExitStack() as stack:
@@ -1062,25 +1115,68 @@ class FittedPipeline(Chainable):
                 _Dataset.of(new_labels).to_array(), dtype=jnp.float32
             )
             if isinstance(feats, ChunkedDataset):
+                ckpt = None
+                start_chunk = 0
                 offset = 0
-                for chunk in feats.raw_chunks():
+                if checkpoint is not None:
+                    import hashlib
+
+                    import numpy as _np_mod
+
+                    from ..faults import FitCheckpoint
+
+                    # the default key binds the APPENDED DATA's identity
+                    # through a digest of the labels (already resident —
+                    # no extra chunk production): a crashed absorb's
+                    # checkpoint must never be resumed by a later absorb
+                    # of DIFFERENT same-shaped data. Callers retrying a
+                    # specific batch pass checkpoint_key for an explicit
+                    # identity (features differing under identical
+                    # labels still need it).
+                    y_digest = hashlib.sha256(
+                        _np_mod.asarray(y).tobytes()
+                    ).hexdigest()[:16]
+                    key = checkpoint_key or (
+                        f"absorb|base={state.n}|new={len(feats)}"
+                        f"|y={tuple(int(s) for s in y.shape)}"
+                        f"|ydig={y_digest}|lam={state.lam}"
+                    )
+                    ckpt = FitCheckpoint(checkpoint, key)
+                    loaded = ckpt.load()
+                    if loaded is not None:
+                        state, start_chunk, offset = loaded
+                        logger.info(
+                            "absorb: resuming at chunk %d (row %d) "
+                            "from %s", start_chunk, offset, ckpt.path,
+                        )
+                every = max(1, int(checkpoint_every))
+                i = start_chunk
+                for chunk in feats.raw_chunks(skip=start_chunk):
+                    if on_chunk is not None:
+                        on_chunk(i, chunk)
                     rows = int(chunk.shape[0])
                     state.update(chunk, y[offset : offset + rows])
                     offset += rows
+                    i += 1
+                    if ckpt is not None and i % every == 0:
+                        ckpt.save(state, i, offset)
                 if offset != int(y.shape[0]):
                     raise ValueError(
                         f"new chunks have {offset} rows, labels {y.shape[0]}"
                     )
+                if ckpt is not None:
+                    ckpt.complete()
             else:
+                if on_chunk is not None:
+                    on_chunk(0, feats)
                 state.update(_Dataset.of(feats).to_array(), y)
-            W, b, mean = state.solve(state.lam)
+            new_mapper = state.rebuild_mapper(mapper)
             if sp is not None:
                 sp.attrs["absorbed_rows"] = int(state.rows_folded)
                 sp.attrs["total_rows"] = int(state.n)
-                sp.sync_on(W)
-        new_mapper = type(mapper)(
-            W, b=b, feature_mean=mean, solver_state=state.snapshot()
-        )
+                solved_w = getattr(new_mapper, "W", None)
+                if solved_w is not None:
+                    sp.sync_on(solved_w)
         updated = FittedPipeline(
             self._graph.set_operator(node, new_mapper),
             self._source,

@@ -10,6 +10,17 @@ from keystone_tpu.parallel.virtual import provision_virtual_devices
 
 provision_virtual_devices(8)
 
+# Belt to the provisioner's braces: the XLA:CPU thunk runtime's
+# collective rendezvous can hang the whole suite on the oversubscribed
+# virtual mesh (see provision_virtual_devices, which opts back into the
+# legacy runtime); pinning dispatch synchronous additionally removes
+# the async-dispatch reordering the same jaxlib era is known for.
+# Compute results and thread-level overlap (scan pipelines, fleets)
+# are unaffected — this is the TEST harness configuration.
+import jax  # noqa: E402
+
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
 import pytest  # noqa: E402
 
 

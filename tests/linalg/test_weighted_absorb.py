@@ -1,0 +1,162 @@
+"""WeightedSolverState: absorb beyond the Gram family (ISSUE 14
+satellite) — the per-class weighted mixture solve from snapshot-able
+accumulators, with the BCD families refusing typed."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.linalg import NotAbsorbable, WeightedSolverState
+from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.learning.weighted import (
+    BlockWeightedLeastSquaresEstimator,
+    PerClassWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.workflow.transformer import FunctionNode
+
+D, K = 12, 4
+LAM, MIX = 1e-2, 0.4
+
+
+def _problem(n, seed=0, offset=1.5):
+    r = np.random.RandomState(seed)
+    X = (r.randn(n, D) + offset).astype(np.float32)
+    yi = r.randint(0, K, n)
+    Y = -np.ones((n, K), np.float32)
+    Y[np.arange(n), yi] = 1.0
+    return X, Y
+
+
+def _est(snapshot=False):
+    return PerClassWeightedLeastSquaresEstimator(
+        5, 1, LAM, MIX, snapshot=snapshot
+    )
+
+
+def _W(mapper):
+    return np.asarray(mapper._W)
+
+
+def test_state_solve_matches_dense_oracle():
+    """The accumulator solve equals the f32 dense per-class oracle on
+    the same data — same objective, f64 state algebra."""
+    X, Y = _problem(240)
+    plain = _est().fit(Dataset.of(X), Dataset.of(Y))
+    snap = _est(snapshot=True).fit(Dataset.of(X), Dataset.of(Y))
+    assert np.max(np.abs(_W(plain) - _W(snap))) <= 1e-4
+    assert np.max(np.abs(np.asarray(plain.b) - np.asarray(snap.b))) <= 1e-4
+    st = snap.solver_state
+    assert isinstance(st, WeightedSolverState)
+    assert st.n == 240 and st.rows_folded == 0  # snapshot zeroes the gate
+
+
+def test_chunked_fit_matches_in_memory():
+    X, Y = _problem(300)
+    whole = _est(snapshot=True).fit(Dataset.of(X), Dataset.of(Y))
+    chunked = _est(snapshot=True).fit(
+        ChunkedDataset.from_array(X, 64), Dataset.of(Y)
+    )
+    # both fold into f64 state; only chunk-local f32 products differ
+    assert np.max(np.abs(_W(whole) - _W(chunked))) <= 1e-5
+
+
+def test_weighted_absorb_matches_from_scratch():
+    """absorb(new chunks) through a frozen featurizer prefix equals a
+    from-scratch snapshot fit on the concatenated data — the Gram-family
+    absorb contract, now for the weighted family."""
+    X, Y = _problem(300)
+    Xn, Yn = _problem(96, seed=1, offset=1.0)
+    prefix = FunctionNode(
+        batch_fn=lambda A: jnp.tanh(A), label="feat"
+    ).to_pipeline()
+    fitted = prefix.and_then(
+        _est(snapshot=True), ChunkedDataset.from_array(X, 64), Dataset.of(Y)
+    ).fit()
+    updated = fitted.absorb(
+        ChunkedDataset.from_array(Xn, 32), Dataset.of(Yn)
+    )
+    scratch = prefix.and_then(
+        _est(snapshot=True),
+        ChunkedDataset.from_array(np.concatenate([X, Xn]), 64),
+        Dataset.of(np.concatenate([Y, Yn])),
+    ).fit()
+
+    def mapper_of(f):
+        return [
+            op for op in f.graph.operators.values() if hasattr(op, "_W")
+        ][0]
+
+    mu, ms = mapper_of(updated), mapper_of(scratch)
+    assert np.max(np.abs(_W(mu) - _W(ms))) <= 1e-5
+    assert np.max(np.abs(np.asarray(mu.b) - np.asarray(ms.b))) <= 1e-5
+    assert mu.solver_state.n == 396
+    # end-to-end predictions agree, and the original stayed frozen
+    got = np.asarray(updated.apply(Dataset.of(Xn[:16])).to_array())
+    want = np.asarray(scratch.apply(Dataset.of(Xn[:16])).to_array())
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    assert mapper_of(fitted).solver_state.n == 300
+
+
+def test_sequential_weighted_absorbs_compose():
+    X, Y = _problem(200)
+    Xb, Yb = _problem(64, seed=2)
+    Xc, Yc = _problem(48, seed=3)
+    fitted = _est(snapshot=True).with_data(
+        Dataset.of(X), Dataset.of(Y)
+    ).fit()
+    twice = fitted.absorb(Dataset.of(Xb), Dataset.of(Yb)).absorb(
+        Dataset.of(Xc), Dataset.of(Yc)
+    )
+    scratch = _est(snapshot=True).with_data(
+        Dataset.of(np.concatenate([X, Xb, Xc])),
+        Dataset.of(np.concatenate([Y, Yb, Yc])),
+    ).fit()
+
+    def mapper_of(f):
+        return [
+            op for op in f.graph.operators.values() if hasattr(op, "_W")
+        ][0]
+
+    assert np.max(
+        np.abs(_W(mapper_of(twice)) - _W(mapper_of(scratch)))
+    ) <= 1e-5
+
+
+def test_state_moments_and_class_bookkeeping():
+    X, Y = _problem(256)
+    st = WeightedSolverState(lam=LAM, mixture_weight=MIX, block_size=5)
+    for i in range(0, 256, 64):
+        st.update(X[i : i + 64], Y[i : i + 64])
+    m = st.moments()
+    np.testing.assert_allclose(m.mean, X.mean(0), atol=1e-4)
+    np.testing.assert_allclose(
+        m.std(), X.astype(np.float64).std(0), rtol=1e-3
+    )
+    assert st.counts.sum() == 256
+    with pytest.raises(ValueError, match="does not match"):
+        st.update(np.zeros((8, D + 1), np.float32), Y[:8])
+
+
+def test_bcd_families_refuse_typed():
+    """BCD iterates are visitation-order-dependent — snapshot=True must
+    raise the typed NotAbsorbable, never fit something absorb would
+    silently get wrong."""
+    with pytest.raises(NotAbsorbable, match="visitation order"):
+        BlockWeightedLeastSquaresEstimator(5, 1, LAM, MIX, snapshot=True)
+    with pytest.raises(NotAbsorbable, match="visitation order"):
+        BlockLeastSquaresEstimator(5, 1, snapshot=True)
+
+
+def test_absorb_without_state_is_typed_not_absorbable():
+    """FittedPipeline.absorb on a BCD-fitted model raises the typed
+    error (a ValueError subclass, so pre-existing callers keep
+    working)."""
+    X, Y = _problem(128)
+    fitted = BlockLeastSquaresEstimator(5, 1, lam=LAM).with_data(
+        Dataset.of(X), Dataset.of(Y)
+    ).fit()
+    with pytest.raises(NotAbsorbable, match="snapshot-able"):
+        fitted.absorb(Dataset.of(X[:16]), Dataset.of(Y[:16]))

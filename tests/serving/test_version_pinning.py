@@ -1,0 +1,139 @@
+"""Per-replica version pinning during long rollouts (ISSUE 14
+satellite): the fleet snapshot reports each replica's model version, and
+a canary window that outlives a replica restart re-pins the restarted
+replica to the OLD version until promotion."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu import faults
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LinearMapEstimator
+from keystone_tpu.serving import ServingFleet
+from keystone_tpu.workflow.transformer import FunctionNode
+
+D, K = 12, 3
+
+
+def _fit(seed=0, n=256):
+    r = np.random.RandomState(seed)
+    X = (r.randn(n, D) + 1.0).astype(np.float32)
+    Y = (np.tanh(X) @ r.randn(D, K).astype(np.float32)).astype(np.float32)
+    return (
+        FunctionNode(batch_fn=lambda A: jnp.tanh(A), label="feat")
+        .to_pipeline()
+        .and_then(
+            LinearMapEstimator(lam=1e-2, snapshot=True),
+            ChunkedDataset.from_array(X, 64),
+            Dataset.of(Y),
+        )
+        .fit(),
+        X,
+    )
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_version_report_tracks_promotions():
+    fitted, X = _fit()
+    replacement = fitted.absorb(Dataset.of(X[:64]), Dataset.of(
+        np.asarray(fitted.apply(Dataset.of(X[:64])).to_array())
+    ))
+    fleet = ServingFleet(
+        fitted, replicas=2, buckets=(8,), datum_shape=(D,), max_wait_ms=1.0
+    )
+    with fleet:
+        r = fleet.version_report()
+        assert r["version"] == 1 and not r["skew"]
+        assert {row["version"] for row in r["replicas"].values()} == {1}
+        report = fleet.swap(replacement)  # no canary: straight promote
+        assert report["version"] == 2
+        r = fleet.version_report()
+        assert r["version"] == 2 and not r["skew"]
+        assert {row["version"] for row in r["replicas"].values()} == {2}
+    assert fleet.model_version == 2
+
+
+def test_restart_inside_canary_window_pins_old_version():
+    """Kill a replica while a canaried swap's window is open: the
+    supervisor restart must re-pin it to version 1 (the published
+    model), and promotion afterwards moves EVERY replica to version 2 —
+    never a mixed fleet."""
+    fitted, X = _fit()
+    labels = np.asarray(fitted.apply(Dataset.of(X[:64])).to_array())
+    replacement = fitted.absorb(Dataset.of(X[:64]), Dataset.of(labels))
+    fleet = ServingFleet(
+        fitted, replicas=2, buckets=(8,), datum_shape=(D,), max_wait_ms=1.0
+    )
+    swap_result = {}
+
+    def do_swap():
+        try:
+            # a WIDE window (many mirrored batches): promotion must not
+            # be able to outrun the kill scheduled inside the window —
+            # replica 1 sees a batch long before 48 mirror, the fleet
+            # restarts it mid-window, and only then does the window
+            # close and promote
+            swap_result["report"] = fleet.swap(
+                replacement,
+                canary_fraction=1.0,
+                canary_batches=48,
+                canary_timeout_s=60.0,
+                atol=0.5, rtol=0.5,
+            )
+        except Exception as e:  # surfaced by the final assert
+            swap_result["error"] = e
+
+    stop = threading.Event()
+    failures = []
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            try:
+                fleet.predict(X[i % len(X)], timeout=15.0)
+            except Exception as e:
+                failures.append(repr(e))
+            i += 1
+
+    with fleet:
+        swapper = threading.Thread(target=do_swap, daemon=True)
+        swapper.start()
+        # window open = shadow installed on the replicas. Traffic starts
+        # only AFTER: with zero live batches nothing mirrors, so the
+        # window cannot close before the kill is scheduled inside it.
+        assert _wait(
+            lambda: any(r._shadow is not None for r in fleet.replicas)
+        )
+        faults.install(faults.parse_plan("replica.batch#1=kill@0"))
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        assert _wait(lambda: fleet.metrics.count("restarts") >= 1)
+        mid = fleet.version_report()
+        # the restarted replica is PINNED to the old version — the
+        # candidate can never leak in before promotion
+        assert mid["version"] == 1 and not mid["skew"], mid
+        faults.clear()
+        swapper.join(timeout=90.0)
+        assert not swapper.is_alive()
+        stop.set()
+        t.join(timeout=5)
+        final = fleet.version_report()
+    assert "error" not in swap_result, swap_result
+    assert swap_result["report"]["version"] == 2
+    assert final["version"] == 2 and not final["skew"], final
+    assert {row["version"] for row in final["replicas"].values()} == {2}
+    assert not failures

@@ -1,0 +1,194 @@
+"""Chaos coverage for every trainer failure path (ISSUE 14 satellite):
+each driven by a deterministic KEYSTONE_FAULTS-style plan, each ending
+with the OLD model serving.
+
+* kill during absorb → the daemon supervisor restarts the loop and the
+  retried absorb RESUMES from the checkpoint, folding state
+  bit-identical to an uninterrupted absorb, never re-producing the
+  folded prefix;
+* injected canary failure → rollback + bounded retry, then chunk-batch
+  quarantine, old model still serving;
+* replica kill mid-swap (inside an open canary window) → supervision
+  restarts the replica re-pinned to the OLD version; after promotion
+  there is zero version skew and zero failed requests.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.trainer import ChunkLog, TrainerDaemon
+
+from .test_daemon import (
+    Traffic,
+    fit_initial,
+    make_chunk,
+    make_daemon,
+    make_fleet,
+    model_state,
+    wait_until,
+)
+
+D, K = 12, 3
+
+
+def test_kill_during_absorb_resumes_bit_identical(tmp_path):
+    """trainer.absorb=kill@2: the 3rd folded chunk kills the loop
+    thread. The supervisor restarts it (budget), the retried absorb
+    resumes from the checkpoint at chunk 2, and the promoted state is
+    BIT-identical to an uninterrupted absorb — with the already-folded
+    chunks 0 and 1 never produced again."""
+    fitted, X0, _ = fit_initial()
+    # the uninterrupted reference: same batch, no chaos
+    batch = [make_chunk(32, 60 + s) for s in range(4)]
+    ref_log = ChunkLog()
+    for X, Y in batch:
+        ref_log.append(X, Y)
+    ds, labels = ref_log.as_chunked(0, 4)
+    ref_state = model_state(fitted.absorb(ds, labels)).solver_state
+
+    fleet = make_fleet(fitted, replicas=1)
+    log = ChunkLog()
+    faults.install(faults.parse_plan("trainer.absorb=kill@2"))
+    with fleet:
+        daemon = make_daemon(
+            fleet, log,
+            min_refit_chunks=4,
+            canary_fraction=0.0,
+            checkpoint_dir=str(tmp_path),
+            max_restarts=1,
+        )
+        with daemon:
+            for X, Y in batch:
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+            got_state = model_state(daemon.fitted).solver_state
+    assert faults.active_plan().injected.get("trainer.absorb") == 1
+    faults.clear()
+    assert fleet.metrics.count("trainer_restarts") == 1
+    assert np.array_equal(got_state.gram, ref_state.gram)
+    assert np.array_equal(got_state.cross, ref_state.cross)
+    assert np.array_equal(got_state.sum_x, ref_state.sum_x)
+    assert got_state.n == ref_state.n
+    # the work gate: chunks 0/1 were folded before the kill and must
+    # never re-produce; chunk 2 (killed mid-on_chunk) produced twice
+    assert log.production_counts == {0: 1, 1: 1, 2: 2, 3: 1}
+
+
+def test_injected_canary_failure_rolls_back_then_quarantines():
+    """trainer.canary=transient@0,1 with ONE allowed retry: both
+    attempts fail the canary gate, the batch parks, the old model keeps
+    serving bit-equal outputs, and nothing was ever promoted."""
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted, replicas=1)
+    log = ChunkLog()
+    faults.install(faults.parse_plan("trainer.canary=transient@0,1"))
+    probe = X0[:8]
+    with fleet:
+        before = np.asarray(
+            [fleet.predict(row, timeout=15.0) for row in probe]
+        )
+        with make_daemon(fleet, log, max_batch_retries=1) as daemon:
+            for s in (1, 2):
+                X, Y = make_chunk(64, 70 + s)
+                log.append(X, Y)
+            assert wait_until(lambda: bool(daemon.parked_batches))
+        after = np.asarray(
+            [fleet.predict(row, timeout=15.0) for row in probe]
+        )
+    faults.clear()
+    assert daemon.parked_batches == [(0, 2)]
+    assert fleet.metrics.count("rollbacks") == 2
+    assert fleet.metrics.count("batch_retries") == 1
+    assert fleet.metrics.count("refits") == 0
+    assert fleet.model_version == 1
+    np.testing.assert_array_equal(before, after)
+
+
+def test_injected_canary_failure_then_clean_retry_promotes():
+    """trainer.canary=transient@0 only: the first attempt rolls back,
+    the bounded retry passes, and the SAME batch promotes — rollback is
+    reversible, not a poison-pill."""
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted, replicas=1)
+    log = ChunkLog()
+    faults.install(faults.parse_plan("trainer.canary=transient@0"))
+    with fleet:
+        with make_daemon(fleet, log, max_batch_retries=1) as daemon:
+            for s in (1, 2):
+                X, Y = make_chunk(64, 80 + s)
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+            assert not daemon.parked_batches
+    faults.clear()
+    assert fleet.metrics.count("rollbacks") == 1
+    assert fleet.model_version == 2
+
+
+def test_ingest_transient_faults_are_retried():
+    """trainer.ingest=transient@0,1: two flaky tails are absorbed by the
+    bounded ingest retry — the loop neither dies nor loses chunks."""
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted, replicas=1)
+    log = ChunkLog()
+    faults.install(faults.parse_plan("trainer.ingest=transient@0,1"))
+    with fleet:
+        with make_daemon(fleet, log, canary_fraction=0.0) as daemon:
+            for s in (1, 2):
+                X, Y = make_chunk(64, 90 + s)
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+    faults.clear()
+    assert fleet.metrics.count("ingest_failures") == 2
+    assert fleet.metrics.count("trainer_restarts") == 0
+
+
+def test_replica_kill_mid_swap_no_version_skew():
+    """A replica dies INSIDE an open canary window: supervision requeues
+    its work and restarts it pinned to the OLD version; the canary
+    completes on live traffic, promotion flips every replica, and the
+    rollout ends with zero skew and zero failed requests."""
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted, replicas=2)
+    log = ChunkLog()
+    with fleet:
+        # a WIDE canary window: promotion must not outrun the kill that
+        # is scheduled inside it (replica 1 executes a batch long before
+        # 32 batches mirror)
+        daemon = make_daemon(
+            fleet, log,
+            canary_batches=32, canary_timeout_s=45.0,
+        )
+        with daemon:
+            for s in (1, 2):
+                X, Y = make_chunk(64, 95 + s)
+                log.append(X, Y)
+            # the canary window is open once the shadow hook installs;
+            # traffic starts only AFTER, so nothing can mirror (and
+            # close the window) before the kill is scheduled inside it
+            assert wait_until(
+                lambda: any(r._shadow is not None for r in fleet.replicas),
+                timeout=20.0,
+            )
+            faults.install(faults.parse_plan("replica.batch#1=kill@0"))
+            with Traffic(fleet, X0) as traffic:
+                assert wait_until(
+                    lambda: fleet.metrics.count("restarts") >= 1,
+                    timeout=20.0,
+                )
+                report = fleet.version_report()
+                # re-pinned to the OLD model
+                assert not report["skew"], report
+                assert wait_until(
+                    lambda: fleet.metrics.count("refits") >= 1,
+                    timeout=30.0,
+                )
+        faults.clear()
+        assert not traffic.failures
+        report = fleet.version_report()
+    assert report["version"] == 2
+    assert not report["skew"], report
+    assert {row["version"] for row in report["replicas"].values()} == {2}
+    assert fleet.metrics.count("restarts") >= 1

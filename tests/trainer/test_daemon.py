@@ -1,0 +1,268 @@
+"""TrainerDaemon: the closed loop under normal operation — promotes on
+cadence and on drift, absorbed models match from-scratch fits, poisoned
+batches quarantine, and the old model keeps serving throughout."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.chunked import ChunkedDataset
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import LinearMapEstimator
+from keystone_tpu.serving import ServingFleet
+from keystone_tpu.trainer import ChunkLog, TrainerDaemon
+from keystone_tpu.workflow.transformer import FunctionNode
+
+D, K = 12, 3
+_W_TRUE = np.random.RandomState(42).randn(D, K).astype(np.float32)
+
+
+def make_chunk(n, seed, shift=0.0, scale=1.0):
+    r = np.random.RandomState(seed)
+    X = (r.randn(n, D) * scale + 1.0 + shift).astype(np.float32)
+    Y = (np.tanh(X) @ _W_TRUE + 0.05 * r.randn(n, K)).astype(np.float32)
+    return X, Y
+
+
+def fit_initial(n=384, chunk_rows=64, lam=1e-2):
+    X0, Y0 = make_chunk(n, 0)
+    fitted = (
+        FunctionNode(batch_fn=lambda A: jnp.tanh(A), label="feat")
+        .to_pipeline()
+        .and_then(
+            LinearMapEstimator(lam=lam, snapshot=True),
+            ChunkedDataset.from_array(X0, chunk_rows),
+            Dataset.of(Y0),
+        )
+        .fit()
+    )
+    return fitted, X0, Y0
+
+
+def make_fleet(fitted, replicas=2):
+    return ServingFleet(
+        fitted, replicas=replicas, buckets=(8,), datum_shape=(D,),
+        max_wait_ms=1.0, max_queue=1024,
+    )
+
+
+def make_daemon(fleet, log, **kw):
+    kw.setdefault("poll_interval_s", 0.01)
+    kw.setdefault("refit_interval_s", 0.05)
+    kw.setdefault("min_refit_chunks", 2)
+    kw.setdefault("canary_fraction", 1.0)
+    kw.setdefault("canary_batches", 1)
+    kw.setdefault("canary_timeout_s", 3.0)
+    kw.setdefault("canary_atol", 0.5)
+    kw.setdefault("canary_rtol", 0.5)
+    kw.setdefault("max_batch_retries", 0)
+    return TrainerDaemon(fleet, log, **kw)
+
+
+class Traffic:
+    """Closed-loop submitters; every failure is recorded (the gates
+    assert zero)."""
+
+    def __init__(self, fleet, data, clients=3):
+        self._fleet = fleet
+        self._data = data
+        self._stop = threading.Event()
+        self.failures = []
+        self._threads = [
+            threading.Thread(target=self._run, args=(t, clients), daemon=True)
+            for t in range(clients)
+        ]
+
+    def _run(self, tid, step):
+        i = tid
+        while not self._stop.is_set():
+            try:
+                self._fleet.predict(self._data[i % len(self._data)], timeout=15.0)
+            except Exception as e:
+                self.failures.append(repr(e))
+            i += step
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+def wait_until(pred, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def model_state(fitted):
+    ops = [
+        op
+        for op in fitted.graph.operators.values()
+        if getattr(op, "solver_state", None) is not None
+    ]
+    assert len(ops) == 1
+    return ops[0]
+
+
+def test_cadence_promote_matches_from_scratch_fit():
+    """Two appended chunks promote on the cadence trigger; the promoted
+    model's solver state equals a from-scratch fit on the concatenated
+    data (same chunk boundaries) to 1e-6, and the fleet now serves it."""
+    fitted, X0, Y0 = fit_initial()
+    fleet = make_fleet(fitted)
+    log = ChunkLog()
+    Xa, Ya = make_chunk(64, 1)
+    Xb, Yb = make_chunk(64, 2)
+    with fleet, Traffic(fleet, X0) as traffic:
+        with make_daemon(fleet, log) as daemon:
+            log.append(Xa, Ya)
+            log.append(Xb, Yb)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+            promoted = daemon.fitted
+        assert not traffic.failures
+        assert fleet.fitted is promoted
+        assert fleet.model_version == 2
+
+    def factory():
+        for i in range(0, 384, 64):
+            yield X0[i : i + 64]
+        yield Xa
+        yield Xb
+
+    scratch = (
+        FunctionNode(batch_fn=lambda A: jnp.tanh(A), label="feat")
+        .to_pipeline()
+        .and_then(
+            LinearMapEstimator(lam=1e-2, snapshot=True),
+            ChunkedDataset(factory, 512, label="concat"),
+            Dataset.of(np.concatenate([Y0, Ya, Yb])),
+        )
+        .fit()
+    )
+    got = model_state(promoted)
+    want = model_state(scratch)
+    assert np.max(np.abs(np.asarray(got.W) - np.asarray(want.W))) <= 1e-6
+    assert got.solver_state.n == 512
+
+
+def test_drift_trigger_refits_without_cadence():
+    """With the cadence off, a seeded mean shift in the appended stream
+    trips the drift trigger and promotes; a stationary stream does not."""
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted)
+    log = ChunkLog()
+    with fleet, Traffic(fleet, X0) as traffic:
+        daemon = make_daemon(
+            fleet, log,
+            refit_interval_s=None,  # drift-only
+            min_refit_chunks=1,
+            canary_atol=5.0, canary_rtol=5.0,  # the shift moves outputs
+            drift_kwargs={"min_rows": 128},
+        )
+        with daemon:
+            # stationary appends: no trigger
+            for s in (1, 2, 3):
+                X, Y = make_chunk(64, 10 + s)
+                log.append(X, Y)
+            time.sleep(0.5)
+            assert fleet.metrics.count("refits") == 0
+            # shifted appends: z = |shift|/sqrt(var/n) ≈ 16 over 256 rows
+            for s in (1, 2, 3, 4):
+                X, Y = make_chunk(64, 20 + s, shift=1.0)
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+        assert not traffic.failures
+
+
+def test_poisoned_batch_rolls_back_parks_and_old_model_serves():
+    """The quarantine discipline: a poisoned batch canary-fails, is
+    parked after its bounded retries, the old executable keeps serving
+    (bit-equal outputs), and a later good batch still promotes."""
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted)
+    log = ChunkLog()
+    probe = X0[:8]
+    with fleet, Traffic(fleet, X0) as traffic:
+        with make_daemon(fleet, log, max_batch_retries=1) as daemon:
+            before = np.asarray(
+                [fleet.predict(row, timeout=15.0) for row in probe]
+            )
+            log.append(
+                np.full((64, D), 1e4, np.float32),
+                np.full((64, K), -1e4, np.float32),
+            )
+            log.append(
+                np.full((64, D), 1e4, np.float32),
+                np.full((64, K), -1e4, np.float32),
+            )
+            assert wait_until(lambda: bool(daemon.parked_batches))
+            assert daemon.parked_batches == [(0, 2)]
+            # bounded retry: 1 retry allowed => exactly 2 rollbacks
+            assert fleet.metrics.count("rollbacks") == 2
+            assert fleet.metrics.count("refits") == 0
+            assert fleet.model_version == 1
+            after = np.asarray(
+                [fleet.predict(row, timeout=15.0) for row in probe]
+            )
+            np.testing.assert_array_equal(before, after)
+            # the loop is not poisoned: a good batch still promotes
+            for s in (1, 2):
+                X, Y = make_chunk(64, 30 + s)
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+        assert not traffic.failures
+
+
+def test_metrics_and_staleness():
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted, replicas=1)
+    log = ChunkLog()
+    with fleet, Traffic(fleet, X0, clients=1) as traffic:
+        with make_daemon(fleet, log) as daemon:
+            stale_before = daemon.staleness_s()
+            for s in (1, 2):
+                X, Y = make_chunk(64, 40 + s)
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+            assert daemon.staleness_s() < stale_before + 30
+            snap = fleet.metrics.snapshot()
+        assert not traffic.failures
+    assert snap["counters"]["absorbed_chunks"] == 2
+    assert snap["counters"]["absorbed_rows"] == 128
+    g = snap["gauges"]
+    assert "drift_score" in g and "staleness_s" in g
+    assert g["trainer_backlog"] == 0
+
+
+def test_absorb_through_daemon_scans_only_new_chunks():
+    """The O(new) work gate at the daemon level: a promoted refresh
+    produced each of its chunks exactly once, and a SECOND refresh never
+    re-produces the first one's chunks."""
+    fitted, X0, _ = fit_initial()
+    fleet = make_fleet(fitted, replicas=1)
+    log = ChunkLog()
+    with fleet:
+        with make_daemon(fleet, log, canary_fraction=0.0) as daemon:
+            for s in (1, 2):
+                X, Y = make_chunk(64, 50 + s)
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 1)
+            assert log.production_counts == {0: 1, 1: 1}
+            for s in (3, 4):
+                X, Y = make_chunk(64, 50 + s)
+                log.append(X, Y)
+            assert wait_until(lambda: fleet.metrics.count("refits") >= 2)
+            assert log.production_counts == {0: 1, 1: 1, 2: 1, 3: 1}
+    assert model_state(daemon.fitted).solver_state.n == 384 + 256

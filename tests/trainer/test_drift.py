@@ -1,0 +1,125 @@
+"""DriftMonitor unit coverage (ISSUE 14 satellite): seeded shifts trip
+at the documented thresholds, stationary streams never do (the
+false-positive bound), and the monitor works with and without labels."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.linalg.accumulators import MomentsState
+from keystone_tpu.trainer import DriftMonitor
+
+D = 8
+
+
+def _baseline(n=4096, seed=0, std=1.0):
+    r = np.random.RandomState(seed)
+    m = MomentsState()
+    m.update((r.randn(n, D) * std + 2.0).astype(np.float64))
+    return m
+
+
+def _stream(monitor, chunks, rows, seed, shift=0.0, std=1.0, mse=None):
+    r = np.random.RandomState(seed)
+    for _ in range(chunks):
+        monitor.observe(
+            r.randn(rows, D) * std + 2.0 + shift,
+            None if mse is None else mse,
+        )
+
+
+def test_stationary_stream_never_trips():
+    """The false-positive bound: max-z over d=8 columns exceeds 6 with
+    probability ~ 8·2Φ(−6) ≈ 1.6e-8 per check — a seeded stationary
+    stream of 50 chunks must never trigger."""
+    mon = DriftMonitor(_baseline(), min_rows=64)
+    for i in range(50):
+        _stream(mon, 1, 64, seed=100 + i)
+        assert mon.should_refit() is None, mon.score()
+    s = mon.score()
+    assert s["z_max"] < 6.0
+    assert s["var_ratio_max"] < 4.0
+
+
+def test_mean_shift_trips_at_documented_threshold():
+    """A 1σ mean shift over 256 recent rows gives z ≈ √256 = 16 ≫ 6;
+    a 0.1σ shift over the same rows gives z ≈ 1.6 and must not trip."""
+    mon = DriftMonitor(_baseline(), min_rows=256)
+    _stream(mon, 4, 64, seed=1, shift=0.1)
+    assert mon.should_refit() is None
+    mon.rebaseline(_baseline())
+    _stream(mon, 4, 64, seed=2, shift=1.0)
+    reason = mon.should_refit()
+    assert reason is not None and "mean shift" in reason
+    assert mon.score()["z_max"] > 6.0
+
+
+def test_variance_shift_trips():
+    """std×3 ⇒ variance ratio ≈ 9 > 4 (mean unchanged, so this exercises
+    the variance trigger, not the mean one); std×1.2 ⇒ ratio ≈ 1.44
+    stays quiet."""
+    mon = DriftMonitor(_baseline(), min_rows=256, z_threshold=50.0)
+    _stream(mon, 4, 64, seed=3, std=1.2)
+    assert mon.should_refit() is None
+    mon.rebaseline(_baseline())
+    _stream(mon, 4, 64, seed=4, std=3.0)
+    reason = mon.should_refit()
+    assert reason is not None and "variance" in reason
+
+
+def test_min_rows_gates_every_trigger():
+    mon = DriftMonitor(_baseline(), min_rows=256)
+    _stream(mon, 1, 64, seed=5, shift=5.0)  # huge shift, tiny sample
+    assert mon.should_refit() is None  # gated below min_rows
+    _stream(mon, 3, 64, seed=5, shift=5.0)  # same stream, enough rows
+    assert mon.should_refit() is not None
+
+
+def test_residual_trigger_with_labels():
+    """Residual ratio: warmup establishes the baseline level; a later
+    sustained blow-up past the documented 2.0 ratio trips even though
+    the feature moments stay stationary."""
+    mon = DriftMonitor(_baseline(), min_rows=64, residual_warmup=2)
+    _stream(mon, 2, 64, seed=6, mse=1.0)  # warmup: baseline mse = 1.0
+    _stream(mon, 2, 64, seed=7, mse=1.1)
+    assert mon.should_refit() is None
+    _stream(mon, 4, 64, seed=8, mse=5.0)
+    reason = mon.should_refit()
+    assert reason is not None and "residual" in reason
+    assert mon.score()["residual_ratio"] > 2.0
+
+
+def test_works_without_labels():
+    """Label-free appends: residual evidence stays None, the moment
+    triggers carry the decision alone."""
+    mon = DriftMonitor(_baseline(), min_rows=256)
+    _stream(mon, 4, 64, seed=9)  # no mse ever observed
+    assert mon.score()["residual_ratio"] is None
+    assert mon.should_refit() is None
+    _stream(mon, 4, 64, seed=10, shift=1.0)
+    assert mon.should_refit() is not None  # moments alone trigger
+
+
+def test_rebaseline_resets_recent_and_residual():
+    mon = DriftMonitor(_baseline(), min_rows=64, residual_warmup=1)
+    _stream(mon, 4, 64, seed=11, shift=1.0, mse=1.0)
+    assert mon.should_refit() is not None
+    mon.rebaseline(_baseline())
+    s = mon.score()
+    assert s["rows"] == 0 and s["residual_ratio"] is None
+    assert mon.should_refit() is None
+
+
+def test_empty_baseline_rejected():
+    with pytest.raises(ValueError, match="fitted moments"):
+        DriftMonitor(MomentsState())
+
+
+def test_zero_baseline_residual_still_triggers():
+    """A perfectly-fitting warmup (baseline mse exactly 0.0) must not
+    disable the residual trigger — the ratio floors the denominator."""
+    mon = DriftMonitor(_baseline(), min_rows=64, residual_warmup=2)
+    _stream(mon, 2, 64, seed=20, mse=0.0)  # noise-free warmup
+    _stream(mon, 4, 64, seed=21, mse=1.0)
+    assert mon.score()["residual_ratio"] is not None
+    reason = mon.should_refit()
+    assert reason is not None and "residual" in reason

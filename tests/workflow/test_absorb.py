@@ -209,3 +209,58 @@ def test_absorb_row_mismatch_raises():
         fitted.absorb(
             ChunkedDataset.from_array(X[:64], 32), Dataset.of(Y[:50])
         )
+
+
+def test_absorb_checkpoint_never_resumes_foreign_data(tmp_path):
+    """A crashed absorb's checkpoint binds the appended data's identity
+    (labels digest in the default key): a later absorb of DIFFERENT
+    same-shaped data must start fresh, never resume the foreign fold."""
+    X, Y = _problem(300)
+    Xa, Ya = _problem(96, seed=7)
+    Xb, Yb = _problem(96, seed=8)
+    fitted = _featurize().to_pipeline().and_then(
+        LinearMapEstimator(lam=0.1, snapshot=True),
+        ChunkedDataset.from_array(X, 64), Dataset.of(Y),
+    ).fit()
+
+    class Boom(Exception):
+        pass
+
+    def killer(i, chunk):
+        if i == 2:
+            raise Boom()
+
+    with pytest.raises(Boom):
+        fitted.absorb(
+            ChunkedDataset.from_array(Xa, 32), Dataset.of(Ya),
+            checkpoint=str(tmp_path), on_chunk=killer,
+        )
+    resumed_b = fitted.absorb(
+        ChunkedDataset.from_array(Xb, 32), Dataset.of(Yb),
+        checkpoint=str(tmp_path),
+    )
+    clean_b = fitted.absorb(
+        ChunkedDataset.from_array(Xb, 32), Dataset.of(Yb)
+    )
+    sa, sb = _model_W(resumed_b).solver_state, _model_W(clean_b).solver_state
+    assert np.array_equal(sa.gram, sb.gram)
+    assert np.array_equal(sa.cross, sb.cross)
+    assert sa.n == sb.n == 396
+
+    # and the SAME data crashed-then-retried DOES resume (bit-identical)
+    with pytest.raises(Boom):
+        fitted.absorb(
+            ChunkedDataset.from_array(Xa, 32), Dataset.of(Ya),
+            checkpoint=str(tmp_path), on_chunk=killer,
+        )
+    resumed_a = fitted.absorb(
+        ChunkedDataset.from_array(Xa, 32), Dataset.of(Ya),
+        checkpoint=str(tmp_path),
+    )
+    clean_a = fitted.absorb(
+        ChunkedDataset.from_array(Xa, 32), Dataset.of(Ya)
+    )
+    assert np.array_equal(
+        _model_W(resumed_a).solver_state.gram,
+        _model_W(clean_a).solver_state.gram,
+    )
