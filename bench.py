@@ -3669,6 +3669,305 @@ def bench_continual_learning() -> dict:
     }
 
 
+def bench_distributed_trace() -> dict:
+    """Distributed observability (keystone_tpu/obs/ + cluster/): the
+    cross-process trace plane, its overhead ceiling, and the always-on
+    flight recorder under chaos.
+
+    Gates:
+      * hop_sum_ok — a traced request under the 2-worker router yields
+        ONE stitched trace whose hop spans (router admission, wire
+        send + transport + reply transport, worker queue, replica
+        batch) sum to within 20% of the measured client latency —
+        per-hop attribution that actually tiles the round trip, not
+        decorative spans;
+      * overhead_p99_ok — tracing ON (sample rate 1.0, spans shipping
+        over stats replies) holds accepted p99 within 10% of tracing
+        OFF on the stall-bearing pipeline (worker-measured, best-of-2
+        per mode: the documented cost ceiling of always-on tracing);
+      * flight_dump_ok — a mid-load worker SIGKILL produces a valid
+        flight-recorder JSON dump containing the `fault.worker_down`
+        kill instant and the last >= 50 span summaries (the ring was
+        recording the whole time, with NO tracer installed — recording
+        is sampling-independent and always on).
+    """
+    import os
+    import signal
+    import statistics
+    import tempfile
+    import threading
+    from collections import defaultdict
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from keystone_tpu.cluster import ClusterRouter
+    from keystone_tpu.obs import tracer as trace_mod
+    from keystone_tpu.serving import MetricsRegistry as _MR
+
+    d = 256
+    stall_s = 0.020
+    buckets = (8,)
+    spec = (
+        "factory", "keystone_tpu.cluster.demo:build_stall_model",
+        {"d": d, "stall_s": stall_s},
+    )
+    rng = np.random.RandomState(11)
+    data = rng.randn(64, d).astype(np.float32)
+
+    def make_router(**kw):
+        return ClusterRouter(
+            spec, workers=2, replicas_per_worker=1, buckets=buckets,
+            datum_shape=(d,), max_wait_ms=2.0, max_queue=1024,
+            spawn_timeout_s=300, **kw,
+        )
+
+    prev_tracer = trace_mod.stop()  # run each phase against a known tracer
+
+    def overhead_windows(n_windows=8, n_requests=1024, clients=16):
+        """Per-request tracing cost, measured drift-proof: ONE traced
+        boot, interleaved windows alternating the sampling knob between
+        0.0 (no per-request spans — the 'tracing off' hot path) and 1.0
+        (every request traced end to end), per-window worker-measured
+        p99 from each window's own samples.
+
+        Separate boots per mode cannot support a 10% p99 gate here: the
+        box's p99 level wanders 2-3x over minutes (measured — page
+        cache, scheduler state), swamping the effect. Adjacent windows
+        on one live router share that level, so their ratio isolates
+        exactly the cost KEYSTONE_TRACE_SAMPLE exists to cap. 16
+        clients run the tier at realistic (sub-saturation) utilization:
+        a 32-client fully-saturated closed loop sits where queueing
+        amplifies ANY added microsecond superlinearly into p99 — a
+        ceiling measured there gates the saturation amplifier, not the
+        tracing cost production traffic would see."""
+        from keystone_tpu.obs.context import Sampler
+
+        p99s = {0.0: [], 1.0: []}
+        trace_mod.stop()
+        trace_mod.install(trace_mod.Tracer())
+        with make_router() as r:
+            with ThreadPoolExecutor(max_workers=clients) as pool:
+                list(pool.map(  # prime off the clock (bucket traces)
+                    lambda i: r.predict(data[i % len(data)]),
+                    range(4 * 2 * buckets[0]),
+                ))
+            seen: dict = {}  # worker name -> completed count last window
+            r.worker_snapshots()  # drain primer spans + counters
+            for snap in r.worker_snapshots():
+                seen[snap["name"]] = snap["counters"].get("completed", 0)
+            for w in range(n_windows):
+                rate = 1.0 if w % 2 else 0.0
+                r._sampler = Sampler(rate)
+                with ThreadPoolExecutor(max_workers=clients) as pool:
+                    list(pool.map(
+                        lambda i: r.predict(data[i % len(data)]),
+                        range(n_requests),
+                    ))
+                window_lats: list = []
+                for snap in r.worker_snapshots():
+                    done = snap["counters"].get("completed", 0)
+                    fresh = done - seen.get(snap["name"], 0)
+                    seen[snap["name"]] = done
+                    # this window's samples are the reservoir's newest
+                    # `fresh` entries (insertion-ordered deque)
+                    if fresh > 0:
+                        window_lats.extend(
+                            (snap.get("sketch") or {}).get(
+                                "latencies", []
+                            )[-fresh:]
+                        )
+                q = _MR._quantiles(sorted(window_lats))
+                p99s[rate].append(round(q.get("p99", float("inf")), 4))
+        trace_mod.stop()
+        return p99s
+
+    try:
+        # -- gate (a): one stitched trace, hops tile the latency ---------
+        trace_mod.install(trace_mod.Tracer())
+        client_lats = []
+        with make_router() as r:
+            from keystone_tpu.obs.context import Sampler
+
+            # primer runs UNSAMPLED so cold-path hops (first-batch bucket
+            # traces) never enter the measured hop population — the
+            # stitched trace then holds exactly the measured requests
+            r._sampler = Sampler(0.0)
+            for i in range(16):  # prime: traces paid, estimates warm
+                r.predict(data[i % len(data)])
+            r._sampler = Sampler(1.0)
+            n_traced = 24
+            for i in range(n_traced):  # single-flight: clean per-hop rows
+                t0 = time.perf_counter()
+                r.predict(data[i % len(data)], timeout=30.0)
+                client_lats.append(time.perf_counter() - t0)
+            span_sets = r.collect_trace(timeout=10.0)
+            stitched_pids = {
+                s["pid"] for spans in span_sets for s in spans
+            }
+        trace_mod.stop()
+        by_trace = defaultdict(dict)
+        for spans in span_sets:
+            for s in spans:
+                tid = (s.get("args") or {}).get("trace_id")
+                if tid:
+                    by_trace[tid][s["name"]] = s
+        need = {
+            "rpc.admission", "rpc.send", "rpc.request",
+            "cluster.handle", "serve.queue", "serve.replica",
+        }
+        hop_sums = []
+        for tid, spans in by_trace.items():
+            if set(spans) < need:
+                continue  # a hop's stats reply raced the collection
+            # transport_s is stamped BEFORE the router pickles the frame,
+            # so it already contains serialize + send — adding the
+            # rpc.send span on top would double-count that interval
+            wire = (
+                (spans["cluster.handle"]["args"].get("transport_s") or 0)
+                + (spans["rpc.request"]["args"].get("reply_transport_s") or 0)
+            )
+            hop_sums.append({
+                "trace_id": tid,
+                "admission_s": spans["rpc.admission"]["dur_s"],
+                "wire_s": wire,
+                "worker_queue_s": spans["serve.queue"]["dur_s"],
+                "replica_batch_s": spans["serve.replica"]["dur_s"],
+                "round_trip_s": spans["rpc.request"]["dur_s"],
+            })
+        sums = [
+            h["admission_s"] + h["wire_s"] + h["worker_queue_s"]
+            + h["replica_batch_s"]
+            for h in hop_sums
+        ]
+        # medians, not per-request pairing: single-flight requests are
+        # iid, and one OS-scheduling outlier must not decide the gate
+        med_sum = statistics.median(sums) if sums else 0.0
+        med_client = statistics.median(client_lats or [1.0])
+        hop_ratio = med_sum / med_client
+        hop_sum_ok = (
+            len(sums) >= n_traced // 2
+            and len(stitched_pids) >= 3
+            and abs(hop_ratio - 1.0) <= 0.20
+        )
+
+        # -- gate (b): tracing-on p99 within 10% of tracing-off ----------
+        win = overhead_windows()
+        trials = {"off": win[0.0], "on": win[1.0]}
+        p99_off = min(win[0.0])
+        p99_on = min(win[1.0])
+        overhead_ratio = p99_on / max(p99_off, 1e-9)
+        overhead_ok = overhead_ratio <= 1.10
+
+        # -- gate (c): SIGKILL mid-load leaves a flight dump -------------
+        flight_dir = tempfile.mkdtemp(prefix="keystone-flight-bench-")
+        os.environ["KEYSTONE_FLIGHT_DIR"] = flight_dir
+        import keystone_tpu.obs.flight as flight_mod
+
+        flight_mod.reset()  # a fresh bounded window for THIS router
+        try:
+            with make_router() as r:
+                stop = [False]
+                served = [0]
+                failures = [0]
+
+                def hammer():
+                    while not stop[0]:
+                        try:
+                            r.predict(data[served[0] % len(data)])
+                            served[0] += 1
+                        except Exception:
+                            failures[0] += 1
+
+                threads = [
+                    threading.Thread(target=hammer) for _ in range(6)
+                ]
+                for t in threads:
+                    t.start()
+                time.sleep(1.0)  # the ring fills with rpc.request rows
+                os.kill(r.worker_pids[0], signal.SIGKILL)
+                time.sleep(1.0)
+                stop[0] = True
+                for t in threads:
+                    t.join()
+                deadline = time.monotonic() + 120
+                while r.live_workers < 2 and time.monotonic() < deadline:
+                    time.sleep(0.25)
+            dumps = sorted(
+                f for f in os.listdir(flight_dir) if "worker_down" in f
+            )
+            dump_doc = None
+            if dumps:
+                with open(os.path.join(flight_dir, dumps[-1])) as f:
+                    dump_doc = json.load(f)
+            entries = (dump_doc or {}).get("entries", [])
+            kill_instants = [
+                e for e in entries
+                if e["kind"] == "instant" and e["name"] == "fault.worker_down"
+            ]
+            span_summaries = [e for e in entries if e["kind"] == "span"]
+            flight_ok = (
+                dump_doc is not None
+                and len(kill_instants) >= 1
+                and len(span_summaries) >= 50
+                and served[0] > 0
+            )
+        finally:
+            os.environ.pop("KEYSTONE_FLIGHT_DIR", None)
+            flight_mod.reset()
+            import shutil
+
+            shutil.rmtree(flight_dir, ignore_errors=True)
+    finally:
+        trace_mod.stop()
+        if prev_tracer is not None:
+            trace_mod.install(prev_tracer)
+
+    med = lambda key: round(  # noqa: E731 — table helper
+        statistics.median([h[key] for h in hop_sums]) if hop_sums else 0.0,
+        5,
+    )
+    return {
+        "gates": {
+            "hop_sum_ok": bool(hop_sum_ok),
+            "overhead_p99_ok": bool(overhead_ok),
+            "flight_dump_ok": bool(flight_ok),
+        },
+        "stitched_trace": {
+            "traced_requests": len(sums),
+            "processes": len(stitched_pids),
+            "hop_medians_s": {
+                "admission": med("admission_s"),
+                "wire": med("wire_s"),
+                "worker_queue": med("worker_queue_s"),
+                "replica_batch": med("replica_batch_s"),
+                "round_trip": med("round_trip_s"),
+            },
+            "hop_sum_median_s": round(med_sum, 5),
+            "client_latency_median_s": round(med_client, 5),
+            "hop_sum_over_client_latency": round(hop_ratio, 3),
+        },
+        "overhead": {
+            "p99_tracing_off_s": round(p99_off, 4),
+            "p99_tracing_on_s": round(p99_on, 4),
+            "trial_p99s": trials,
+            "ratio": round(overhead_ratio, 3),
+            "sample_knob": (
+                "KEYSTONE_TRACE_SAMPLE (default 1.0; this run traced "
+                "every request — the measured ratio IS the ceiling; "
+                "the flight recorder ignores sampling)"
+            ),
+        },
+        "flight_dump": {
+            "dumps_written": len(dumps),
+            "kill_instants": len(kill_instants),
+            "span_summaries_in_window": len(span_summaries),
+            "served_around_kill": served[0],
+            "client_failures": failures[0],
+        },
+    }
+
+
 def _section(name, fn):
     """Run one bench section with stderr progress (stdout stays pure JSON)."""
     import sys
@@ -3709,6 +4008,9 @@ def main() -> int:
     fault_tolerance = _section("fault_tolerance", bench_fault_tolerance)
     continual_learning = _section(
         "continual_learning", bench_continual_learning
+    )
+    distributed_trace = _section(
+        "distributed_trace", bench_distributed_trace
     )
     from keystone_tpu.obs import tracer as trace_mod
 
@@ -3758,6 +4060,7 @@ def main() -> int:
                     "sharded_scan": sharded_scan,
                     "fault_tolerance": fault_tolerance,
                     "continual_learning": continual_learning,
+                    "distributed_trace": distributed_trace,
                     "trace": trace_extra,
                 },
             }

@@ -94,6 +94,17 @@ print(
 )
 PY
 echo "== boot 5 (router + 2 worker processes, warm: zero compiles in every worker) =="
-"${run[@]}" --workers 2 --expect-zero-compiles "$@"
+out5="$(mktemp /tmp/keystone-serve-status-XXXXXX.log)"
+"${run[@]}" --workers 2 --expect-zero-compiles --status "$@" | tee "$out5"
+# --status rendered the fleet-wide timeline view (per-process rows)
+grep -q "cluster status: workers 2/2" "$out5" || {
+  echo "STATUS FAIL: fleet liveness line missing from --status output"
+  rm -f "$out5"; exit 1;
+}
+grep -q "timeline \[worker-0\]" "$out5" || {
+  echo "STATUS FAIL: no per-worker timeline in --status output"
+  rm -f "$out5"; exit 1;
+}
+rm -f "$out5"
 echo "== boot 6 (continual learning: trainer daemon promotes refreshes, rolls back the poisoned batch) =="
 env JAX_PLATFORMS=cpu python -m keystone_tpu --trainer-demo --backend cpu

@@ -405,6 +405,15 @@ print(
 )
 PY
 
+# Stage 9 (below, after stage 8): distributed tracing + flight recorder
+# (keystone_tpu/obs/context.py, flight.py, cluster/). A router + 2 worker
+# processes serve one traced request; the stitched export must contain a
+# cross-process span tree: >= 3 hops under one trace id spanning >= 2
+# pids, with wire (transport_s) and queue (queue_age_s) attribution and
+# per-pid process_name tracks. A worker then gets SIGKILLed and the
+# router's always-on flight recorder must leave a JSON dump containing
+# the fault.worker_down instant.
+
 # Stage 8: static --check mode (keystone_tpu/check/). Running mnist with
 # --check must emit a non-empty `check.report` span whose segment plan
 # has >= 2 traceable segments, with ZERO sampled executions recorded on
@@ -438,4 +447,95 @@ print(
     f"CHECK SPAN OK: {args['nodes']} nodes, {args['segments']} segments, "
     f"sampling_total=0, no execution spans"
 )
+PY
+
+# -- distributed tracing + flight recorder ------------------------------------
+flight_dir="$(mktemp -d /tmp/keystone-flight-smoke-XXXXXX)"
+trap 'rm -rf "$aot_dir" "$prof_dir" "$flight_dir"' EXIT
+out9="$(mktemp /tmp/keystone-stitched-XXXXXX.json)"
+env JAX_PLATFORMS=cpu KEYSTONE_FLIGHT_DIR="$flight_dir" \
+  python - "$out9" "$flight_dir" <<'PY'
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+from keystone_tpu.cluster import ClusterRouter
+from keystone_tpu.obs import tracer as trace_mod
+
+trace_mod.install(trace_mod.Tracer())
+r = ClusterRouter(
+    ("factory", "keystone_tpu.cluster.demo:build_stall_model",
+     {"d": 32, "stall_s": 0.002}),
+    workers=2, replicas_per_worker=1, buckets=(8,), datum_shape=(32,),
+    max_wait_ms=1.0, spawn_timeout_s=300,
+)
+data = np.random.RandomState(0).randn(8, 32).astype(np.float32)
+with r:
+    r.predict(data[0], timeout=30.0)  # THE traced request
+    path = r.export_trace(sys.argv[1])
+
+    with open(path) as f:
+        doc = json.load(f)
+    ev = doc["traceEvents"]
+    procs = {e["pid"]: e["args"]["name"] for e in ev
+             if e["name"] == "process_name"}
+    assert len(procs) >= 3, procs  # router + 2 workers, distinct pids
+    assert any("router" in n for n in procs.values()), procs
+    assert sum("worker" in n for n in procs.values()) >= 2, procs
+    ts = [e["ts"] for e in ev]
+    assert all(b >= a for a, b in zip(ts, ts[1:])), "non-monotonic ts"
+    from collections import defaultdict
+
+    by_trace = defaultdict(list)
+    for e in ev:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace[tid].append(e)
+    # the stitched span tree: one trace id, >= 3 hops, >= 2 processes,
+    # wire + queue attribution on the hops that own them
+    best = max(by_trace.values(), key=lambda s: len({e["name"] for e in s}))
+    names = {e["name"] for e in best}
+    assert len(names) >= 3, names
+    assert {"rpc.request", "cluster.handle", "serve.replica"} <= names, names
+    assert len({e["pid"] for e in best}) >= 2, best
+    handle = next(e for e in best if e["name"] == "cluster.handle")
+    assert float(handle["args"]["transport_s"]) >= 0.0, handle
+    queue = next(e for e in best if e["name"] == "serve.queue")
+    assert float(queue["args"]["queue_age_s"]) >= 0.0, queue
+    print(
+        f"STITCHED TRACE OK: {len(names)} hop span(s) over "
+        f"{len({e['pid'] for e in best})} process(es), "
+        f"{len(procs)} process tracks -> {path}"
+    )
+
+    # the chaos half: SIGKILL one worker; the router's always-on flight
+    # recorder must leave a post-mortem dump with the kill instant
+    os.kill(r.worker_pids[0], signal.SIGKILL)
+    deadline = time.monotonic() + 60
+    dumps = []
+    while time.monotonic() < deadline:
+        try:
+            r.predict(data[1], timeout=30.0)  # keeps the tier moving
+        except Exception:
+            pass
+        dumps = [f for f in os.listdir(sys.argv[2]) if "worker_down" in f]
+        if dumps:
+            break
+        time.sleep(0.1)
+    assert dumps, "no flight-recorder dump after the worker kill"
+    with open(os.path.join(sys.argv[2], sorted(dumps)[-1])) as f:
+        dump = json.load(f)
+    kills = [e for e in dump["entries"]
+             if e["kind"] == "instant" and e["name"] == "fault.worker_down"]
+    assert kills, [e["name"] for e in dump["entries"]][-20:]
+    spans = [e for e in dump["entries"] if e["kind"] == "span"]
+    print(
+        f"FLIGHT DUMP OK: trigger={dump['trigger']} "
+        f"kill_instants={len(kills)} span_summaries={len(spans)} "
+        f"-> {sorted(dumps)[-1]}"
+    )
 PY

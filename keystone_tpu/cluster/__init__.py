@@ -34,7 +34,7 @@ the tier; see the README's "Multi-process serving" section for the
 topology and the warm-boot contract.
 """
 
-from .router import ClusterRouter, default_workers
+from .router import ClusterRouter, default_workers, format_status
 from .wire import ConnectionClosed, WorkerError
 
 __all__ = [
@@ -42,4 +42,5 @@ __all__ = [
     "ConnectionClosed",
     "WorkerError",
     "default_workers",
+    "format_status",
 ]

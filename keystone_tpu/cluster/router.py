@@ -59,11 +59,15 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..faults import WORKER_SPAWN, fault_point
+from ..obs import flight as _flight
+from ..obs.context import Sampler, TraceContext, new_trace_id
+from ..obs.span import Span
 from ..obs.tracer import current as _trace_current
 from ..serving.errors import EngineStopped, QueueFull, Shed
 from ..serving.metrics import MetricsRegistry
 from ..serving.scheduler import ServiceEstimate
 from ..serving.replica import settle_future
+from ..serving.slo import SloPolicy, SloWatchdog
 from ..utils import env_int as _env_int
 from . import wire as wire_mod
 from .wire import (
@@ -94,6 +98,11 @@ class _PendingReq:
     enqueued: float
     future: Future = field(default_factory=Future)
     hops: int = 0
+    #: cross-process trace identity for a sampled request (None when
+    #: tracing is off or the request lost the sampling draw)
+    trace: Optional[TraceContext] = None
+    #: perf_counter at admission — the rpc.request span's start
+    t_submit_pc: float = 0.0
 
 
 class _WorkerSlot:
@@ -122,6 +131,11 @@ class _WorkerSlot:
         self.stats_seq = 0
         self.stats_event = threading.Event()
         self.recv_thread: Optional[threading.Thread] = None
+        #: worker spans accumulated off stats replies (each worker ships
+        #: its fresh spans exactly once, cursor-tracked worker-side) —
+        #: what export_trace stitches into cross-process tracks. Kept
+        #: across respawns: a dead worker's spans are the evidence.
+        self.trace_spans: List[dict] = []
 
 
 class ClusterRouter:
@@ -156,6 +170,8 @@ class ClusterRouter:
         log_interval_s: float = 10.0,
         virtual_devices: Optional[int] = None,
         log_level: Optional[str] = None,
+        slo: Optional[SloPolicy] = None,
+        trace_sample: Optional[float] = None,
     ):
         self._n = workers if workers is not None else default_workers()
         if self._n < 1:
@@ -201,6 +217,24 @@ class ClusterRouter:
         self._closed = False
         self._prev_sigterm = None
         self._metrics.set_gauge("queue_depth", lambda: self.outstanding)
+        #: per-request trace sampling (KEYSTONE_TRACE_SAMPLE unless the
+        #: trace_sample arg overrides); drawn under the admission lock
+        self._sampler = Sampler(trace_sample)
+        self._trace_seq = itertools.count()
+        #: the SLO watchdog rides the health loop's cadence; without a
+        #: policy the loop still samples the metrics timeline
+        self._watchdog = (
+            SloWatchdog(self._metrics, slo, source="cluster-router")
+            if slo is not None else None
+        )
+        #: the router's own spans, moved out of the process tracer into
+        #: this bounded buffer at each collect_trace (mirrors the
+        #: per-slot worker buffers) — a long-lived traced router that
+        #: exports periodically stays bounded instead of holding every
+        #: sampled request's spans for its whole uptime
+        self._own_trace_spans: List[dict] = []
+        self._own_span_cursor = 0
+        self._own_trace_lock = threading.Lock()
 
     @staticmethod
     def _resolve_model_spec(model) -> tuple:
@@ -293,6 +327,11 @@ class ClusterRouter:
             if self._closed:
                 raise EngineStopped("router was shut down")
             self._started = True
+            # tracing propagates at boot: a traced router asks its
+            # workers to install tracers too, so their spans ship back
+            # and stitch (decided here, not __init__, because configure/
+            # --trace may install the tracer between construct and start)
+            self._spec["trace"] = _trace_current() is not None
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", 0))
@@ -462,6 +501,20 @@ class ClusterRouter:
                         if est is not None:
                             self._service.observe(float(est))
                 elif kind == "stats":
+                    if msg.get("spans_dropped"):
+                        logger.warning(
+                            "cluster: worker %d overflowed its span "
+                            "shipping window — %s span(s) lost to the "
+                            "stitched trace (collect more often)",
+                            slot.index, msg["spans_dropped"],
+                        )
+                    spans = msg.get("spans")
+                    if spans:
+                        # accumulate every worker's shipped spans for
+                        # stitching (cursor-tracked worker-side, so this
+                        # never double-counts); bounded like a ring
+                        slot.trace_spans.extend(spans)
+                        del slot.trace_spans[:-8192]
                     if msg.get("seq") == slot.stats_seq:
                         slot.last_snapshot = msg.get("snapshot")
                         slot.stats_event.set()
@@ -486,12 +539,39 @@ class ClusterRouter:
             self._cond.notify_all()
         if req is None:
             return  # already settled (requeue raced a late answer)
-        if msg.get("ok"):
+        latency = time.monotonic() - req.enqueued
+        ok = bool(msg.get("ok"))
+        # the always-on flight ring: every answered request leaves a
+        # round-trip summary regardless of sampling, so a worker-death
+        # dump shows exactly what the tier was serving when it happened
+        _flight.record_span(
+            "rpc.request", latency, worker=slot.index, ok=ok,
+        )
+        if req.trace is not None:
+            tracer = _trace_current()
+            if tracer is not None:
+                end_pc = time.perf_counter()
+                reply_unix = msg.get("t_unix")
+                tracer.record_complete(Span(
+                    name="rpc.request",
+                    start=req.t_submit_pc,
+                    end=end_pc,
+                    op_type="ClusterRouter",
+                    attrs={
+                        "trace_id": req.trace.trace_id,
+                        "worker": slot.index,
+                        "ok": ok,
+                        "hops": req.hops,
+                        "reply_transport_s": (
+                            round(max(0.0, time.time() - reply_unix), 6)
+                            if reply_unix is not None else None
+                        ),
+                    },
+                ))
+        if ok:
             if settle_result(req.future, msg.get("value")):
                 self._metrics.inc("completed")
-                self._metrics.observe_latency(
-                    time.monotonic() - req.enqueued
-                )
+                self._metrics.observe_latency(latency)
         else:
             exc = decode_error(msg.get("error") or {})
             # a decoded worker-side Shed is NOT counted here: the worker
@@ -551,6 +631,14 @@ class ClusterRouter:
                 worker=slot.index, requeued=len(orphans),
                 restarting=will_restart,
             )
+        # the post-mortem artifact: the kill instant plus the last ring
+        # of span summaries — always on, sampling does not apply
+        _flight.record_instant(
+            "fault.worker_down", worker=slot.index,
+            requeued=len(orphans), restarting=will_restart,
+            cause=str(exc)[:200],
+        )
+        _flight.dump("worker_down")
         moved = 0
         for req in orphans:
             if req.future.done():
@@ -571,6 +659,10 @@ class ClusterRouter:
                     "cluster: respawn of worker %d failed", slot.index
                 )
             else:
+                _flight.record_instant(
+                    "fault.worker_restart", worker=slot.index,
+                    attempt=slot.restarts,
+                )
                 if tracer is not None:
                     tracer.instant(
                         "fault.worker_restart", op_type="ClusterRouter",
@@ -617,8 +709,17 @@ class ClusterRouter:
                 datum=datum,
                 deadline=(now + timeout) if timeout is not None else None,
                 enqueued=now,
+                t_submit_pc=time.perf_counter(),
             )
             self._metrics.inc("submitted")
+            # the sampling draw happens under the admission lock (the
+            # sampler is a plain counter); an unsampled request pays
+            # exactly this one modulo check
+            if self._sampler.admit() and _trace_current() is not None:
+                req.trace = TraceContext(
+                    trace_id=new_trace_id(next(self._trace_seq)),
+                    hop="rpc.request",
+                )
         self._route(req)
         return req.future
 
@@ -668,13 +769,43 @@ class ClusterRouter:
                 self._pending[req_id] = req
                 slot.outstanding.add(req_id)
             try:
+                msg = {
+                    "type": "req",
+                    "id": req_id,
+                    "datum": req.datum,
+                    "deadline_rem": deadline_to_wire(req.deadline),
+                }
+                tracer = _trace_current() if req.trace is not None else None
+                if req.trace is not None:
+                    # the stamp necessarily precedes pickling (it rides
+                    # the frame), so the receiver's transport_s INCLUDES
+                    # serialize + send — consumers summing hops must use
+                    # transport_s OR the rpc.send span, never both
+                    t_send_pc = time.perf_counter()
+                    msg["trace"] = req.trace.to_wire()
                 with slot.send_lock:
-                    send_msg(slot.sock, {
-                        "type": "req",
-                        "id": req_id,
-                        "datum": req.datum,
-                        "deadline_rem": deadline_to_wire(req.deadline),
-                    })
+                    send_msg(slot.sock, msg)
+                if tracer is not None:
+                    done_pc = time.perf_counter()
+                    attrs = {
+                        "trace_id": req.trace.trace_id,
+                        "worker": slot.index,
+                        "hops": req.hops,
+                    }
+                    # the admission hop (submit -> send start: front-door
+                    # pricing + placement) and the wire-send hop
+                    # (pickle + sendall), recorded as completed spans —
+                    # the submitting thread cannot hold them open across
+                    # the response's arrival on the recv thread
+                    tracer.record_complete(Span(
+                        name="rpc.admission", start=req.t_submit_pc,
+                        end=t_send_pc, op_type="ClusterRouter",
+                        attrs=dict(attrs),
+                    ))
+                    tracer.record_complete(Span(
+                        name="rpc.send", start=t_send_pc, end=done_pc,
+                        op_type="ClusterRouter", attrs=dict(attrs),
+                    ))
                 return True
             except Exception as e:
                 # the worker died under us: undo the bookkeeping and let
@@ -707,6 +838,17 @@ class ClusterRouter:
                     self._on_worker_down(
                         slot, ConnectionClosed(f"ping failed: {e}")
                     )
+            try:
+                # one timeline row per health tick; with a policy set the
+                # watchdog samples AND judges it (breaches land in the
+                # flight ring + counters), without one the row still
+                # accumulates for status()/snapshot() readers
+                if self._watchdog is not None:
+                    self._watchdog.tick()
+                else:
+                    self._metrics.sample_timeline()
+            except Exception:
+                logger.exception("cluster: timeline sample failed")
             now = time.monotonic()
             if now - last_log >= self._log_interval_s:
                 last_log = now
@@ -771,13 +913,14 @@ class ClusterRouter:
         occ = (snap.get("batch_occupancy") or {}).get("ratio")
         logger.info(
             "cluster-router: workers=%d/%d outstanding=%d counters=%s "
-            "occupancy=%s shed=%s p99=%s queue_age_p99=%s",
+            "occupancy=%s shed=%s p99=%s queue_age_p99=%s slo_breaches=%s",
             sum(1 for s in self._slots if s.alive), self._n,
             self.outstanding, c,
             None if occ is None else round(occ, 3),
             c.get("shed", 0),
             round(lat["p99"], 4) if "p99" in lat else None,
             round(age["p99"], 4) if "p99" in age else None,
+            c.get("slo_breaches", 0),
         )
 
     def worker_snapshots(self, timeout: float = 2.0) -> List[dict]:
@@ -852,6 +995,120 @@ class ClusterRouter:
                 c[f"worker_{key}"] = total - mine
             c[key] = mine
         return merged
+
+    # -- cross-process trace stitching + fleet status --------------------
+
+    def collect_trace(self, timeout: float = 2.0) -> List[List[dict]]:
+        """Every process's span set in wire form: the router's own spans
+        plus what each worker has shipped (a stats round-trip first, so
+        fresh worker spans arrive). Ready for
+        :func:`keystone_tpu.obs.export.stitch_chrome_trace`.
+
+        Collection COMPACTS: the router's fresh spans move from the
+        process tracer into a bounded buffer (and workers discard what
+        they ship), so a deployment that exports periodically holds a
+        bounded window per process — the stitched file is the archive.
+        A traced router that never collects keeps the ordinary
+        process-tracer contract (spans retained for the atexit export)."""
+        from ..obs.export import wire_spans
+
+        # a stats request makes every live worker ship its fresh spans;
+        # the reply handler accumulates them on the slots
+        self.worker_snapshots(timeout=timeout)
+        sets: List[List[dict]] = []
+        tracer = _trace_current()
+        if tracer is not None:
+            # serialized OUTSIDE the admission lock (a first collect
+            # after a long traced window may hold many spans, and
+            # submit()/answer settlement must not stall behind it);
+            # _own_trace_lock serializes concurrent collectors
+            with self._own_trace_lock:
+                fresh, self._own_span_cursor = tracer.spans_since(
+                    self._own_span_cursor
+                )
+                # only what the bounded buffer will keep gets serialized
+                self._own_trace_spans.extend(wire_spans(
+                    fresh[-8192:], tracer.epoch, tracer.epoch_unix,
+                    process_name=f"keystone:router/{os.getpid()}",
+                ))
+                del self._own_trace_spans[:-8192]
+                tracer.discard_through(self._own_span_cursor)
+                if self._own_trace_spans:
+                    sets.append(list(self._own_trace_spans))
+        with self._lock:
+            for slot in self._slots:
+                if slot.trace_spans:
+                    sets.append(list(slot.trace_spans))
+        return sets
+
+    def export_trace(self, path: str, timeout: float = 2.0) -> str:
+        """Write ONE stitched Chrome-trace/Perfetto JSON covering the
+        whole process tier: real per-pid process tracks, worker spans
+        rebased onto the shared unix clock, and each sampled request's
+        hops tied together by its ``trace_id`` attr."""
+        from ..obs.export import write_stitched_trace
+
+        return write_stitched_trace(self.collect_trace(timeout=timeout), path)
+
+    def status(self, timeout: float = 2.0, snap: Optional[dict] = None) -> dict:
+        """The fleet-wide timeline view: liveness + capacity, the merged
+        counters/quantiles, each tier's bounded metrics timeline (kept
+        per-process — see ``MetricsRegistry.merge``), restart budgets,
+        and the SLO verdicts. The programmatic form behind the demo
+        CLI's ``--status`` rendering (:func:`format_status`). ``snap``
+        reuses a merged snapshot the caller already paid the worker
+        stats round-trip for."""
+        if snap is None:
+            snap = self.snapshot(timeout=timeout)
+        with self._lock:
+            workers = [
+                {
+                    "index": s.index,
+                    "alive": s.alive,
+                    "pid": s.proc.pid if s.proc is not None else None,
+                    "capacity": s.capacity,
+                    "restarts": s.restarts,
+                    "outstanding": len(s.outstanding),
+                    "respawning": s.respawning,
+                }
+                for s in self._slots
+            ]
+        timelines = dict(snap.get("timelines") or {})
+        # the router's own rows ride under its registry name so the view
+        # shows every tier side by side, never blended; a status read
+        # before the first health tick samples one row rather than
+        # rendering an empty tier
+        own_rows = self._metrics.timeline()
+        if not own_rows:
+            own_rows = [self._metrics.sample_timeline()]
+        timelines.setdefault(self._metrics.name, own_rows)
+        out = {
+            "workers": workers,
+            "live_workers": sum(1 for w in workers if w["alive"]),
+            "outstanding": self.outstanding,
+            "capacity": self.capacity,
+            "counters": snap.get("counters", {}),
+            "latency": snap.get("latency", {}),
+            "queue_age": snap.get("queue_age", {}),
+            "batch_occupancy": snap.get("batch_occupancy"),
+            "timelines": timelines,
+            "slo": None,
+        }
+        if self._watchdog is not None:
+            from dataclasses import asdict
+
+            out["slo"] = {
+                "policy": {
+                    k: v
+                    for k, v in asdict(self._watchdog.policy).items()
+                    if v is not None
+                },
+                "breaches": [
+                    b.as_attrs() | {"ts": b.ts}
+                    for b in self._watchdog.breaches[-32:]
+                ],
+            }
+        return out
 
     # -- shutdown --------------------------------------------------------
 
@@ -978,6 +1235,67 @@ class ClusterRouter:
 
     def __exit__(self, *exc) -> None:
         self.shutdown(drain=True)
+
+
+def format_status(status: dict) -> str:
+    """Render :meth:`ClusterRouter.status` as the operator-facing text
+    view: a worker table, headline counters, and each tier's metrics
+    timeline as one line per sample (windowed counters + p99s) — the
+    queue-age-over-time picture a point snapshot cannot give."""
+    lines = [
+        "cluster status: workers {}/{} capacity {} outstanding {}".format(
+            status.get("live_workers", 0),
+            len(status.get("workers") or []),
+            status.get("capacity", 0),
+            status.get("outstanding", 0),
+        )
+    ]
+    for w in status.get("workers") or []:
+        lines.append(
+            "  worker {index}: {state} pid={pid} capacity={capacity} "
+            "restarts={restarts} outstanding={outstanding}".format(
+                state=(
+                    "respawning" if w.get("respawning")
+                    else "up" if w.get("alive") else "DOWN"
+                ),
+                **{k: w.get(k) for k in (
+                    "index", "pid", "capacity", "restarts", "outstanding"
+                )},
+            )
+        )
+    c = status.get("counters") or {}
+    lat = status.get("latency") or {}
+    lines.append(
+        "  counters: completed={} shed={} rejected={} restarts={} "
+        "requeues={} slo_breaches={} p99={}".format(
+            c.get("completed", 0), c.get("shed", 0), c.get("rejected", 0),
+            c.get("restarts", 0), c.get("requeues", 0),
+            c.get("slo_breaches", 0),
+            round(lat["p99"], 4) if "p99" in lat else None,
+        )
+    )
+    slo = status.get("slo")
+    if slo:
+        lines.append(f"  slo policy: {slo.get('policy')}")
+        for b in (slo.get("breaches") or [])[-8:]:
+            lines.append(
+                "    BREACH {objective}: observed {observed} vs budget "
+                "{budget}".format(**b)
+            )
+    for name, rows in sorted((status.get("timelines") or {}).items()):
+        lines.append(f"  timeline [{name}] ({len(rows)} samples):")
+        for row in rows[-10:]:
+            lat = row.get("latency") or {}
+            age = row.get("queue_age") or {}
+            lines.append(
+                "    t={:.1f} counters={} p99={} queue_age_p99={}".format(
+                    row.get("ts", 0.0),
+                    row.get("counters") or {},
+                    round(lat["p99"], 4) if "p99" in lat else None,
+                    round(age["p99"], 4) if "p99" in age else None,
+                )
+            )
+    return "\n".join(lines)
 
 
 def settle_result(fut: Future, value: Any) -> bool:

@@ -30,6 +30,16 @@ process boundary:
 
 Message payloads are plain dicts with a ``"type"`` key; numpy arrays
 pickle efficiently enough for a localhost hop (protocol 5).
+
+**Trace propagation.** A sampled request's ``req`` frame additionally
+carries ``"trace"`` — the :class:`~keystone_tpu.obs.context.TraceContext`
+wire form (trace id, emitting hop, a ``time.time()`` send stamp) — and
+every ``res`` frame carries ``"t_unix"``. Monotonic clocks are
+process-local, so cross-process latency attribution rides the HOST-shared
+unix clock: the receiver prices each direction's transport as
+``time.time() - stamp`` and records it on its hop span, which is how the
+stitched trace (``obs/export.py``) shows per-hop serialize/transport/
+queue time instead of one opaque round-trip.
 """
 
 from __future__ import annotations

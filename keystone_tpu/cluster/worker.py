@@ -103,6 +103,11 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
 
         compile_mod.configure(spec["aot_cache"])
 
+    from ..obs import flight as _flight
+    from ..obs import tracer as _obs_tracer
+    from ..obs.context import TraceContext
+    from ..obs.export import wire_spans
+    from ..obs.span import Span
     from ..serving import ServingFleet
     from .wire import (
         ConnectionClosed,
@@ -113,6 +118,16 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     )
 
     from .wire import SEND_TIMEOUT_S
+
+    # the flight recorder is always on; SIGQUIT gives operators an
+    # on-demand post-mortem dump of a live worker
+    _flight.install_sigquit_dump()
+    # the router propagates its tracing decision: a traced router means
+    # traced workers, whose spans ship back on stats replies and stitch
+    # into ONE cross-process trace (obs/export.py)
+    tracer = _obs_tracer.start() if spec.get("trace") else None
+    process_name = f"keystone:worker-{worker_id}/{os.getpid()}"
+    span_cursor = [0]  # spans_since bookmark: each span ships once
 
     sock = socket.create_connection((host, port), timeout=30.0)
     # bounded sends, timeout-tolerant receives (see wire.SEND_TIMEOUT_S)
@@ -194,15 +209,23 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     except ValueError:
         pass  # non-main thread (embedded use): router stop still works
 
-    def _answer(req_id: int, fut) -> None:
+    def _answer(req_id: int, fut, ctx=None, t_recv_pc=None,
+                transport_s=None) -> None:
+        import time as _time
+
         try:
             value = fut.result()
-            reply({"type": "res", "id": req_id, "ok": True, "value": value})
+            # t_unix lets the router price the REPLY hop's transport
+            # (unix clocks are host-shared; monotonic ones are not)
+            reply({
+                "type": "res", "id": req_id, "ok": True, "value": value,
+                "t_unix": _time.time(),
+            })
         except BaseException as e:  # noqa: BLE001 — typed over the wire
             try:
                 reply({
                     "type": "res", "id": req_id, "ok": False,
-                    "error": encode_error(e),
+                    "error": encode_error(e), "t_unix": _time.time(),
                 })
             except Exception:
                 # router gone; its death handling requeues
@@ -210,6 +233,24 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
                     "reply for request %d undeliverable", req_id,
                     exc_info=True,
                 )
+        if ctx is not None and tracer is not None:
+            # the worker-residency hop: wire arrival -> reply sent,
+            # stitched under the request's cross-process identity with
+            # the inbound transport it measured off the wire stamp
+            tracer.record_complete(Span(
+                name="cluster.handle",
+                start=t_recv_pc,
+                end=_time.perf_counter(),
+                op_type="ClusterWorker",
+                attrs={
+                    "trace_id": ctx.trace_id,
+                    # the sender's hop: which edge this residency span
+                    # hangs under in the stitched tree
+                    "parent_hop": ctx.hop,
+                    "worker": worker_id,
+                    "transport_s": round(transport_s or 0.0, 6),
+                },
+            ))
 
     rc = 0
     try:
@@ -219,35 +260,77 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
             if kind == "req":
                 req_id = msg["id"]
                 deadline = deadline_from_wire(msg.get("deadline_rem"))
-                try:
-                    import time as _time
+                ctx = TraceContext.from_wire(msg.get("trace"))
+                import time as _time
 
+                t_recv_pc = _time.perf_counter()
+                transport_s = (
+                    ctx.transport_seconds() if ctx is not None else None
+                )
+                try:
                     timeout = (
                         None if deadline is None
                         else max(0.0, deadline - _time.monotonic())
                     )
-                    fut = fleet.submit(msg["datum"], timeout=timeout)
+                    fut = fleet.submit(
+                        msg["datum"], timeout=timeout, trace=ctx
+                    )
                 except BaseException as e:  # Shed/QueueFull/... typed back
                     reply({
                         "type": "res", "id": req_id, "ok": False,
-                        "error": encode_error(e),
+                        "error": encode_error(e), "t_unix": _time.time(),
                     })
                     continue
                 fut.add_done_callback(
-                    lambda f, rid=req_id: _answer(rid, f)
+                    lambda f, rid=req_id, c=ctx, t=t_recv_pc,
+                    tr=transport_s: _answer(
+                        rid, f, ctx=c, t_recv_pc=t, transport_s=tr
+                    )
                 )
             elif kind == "ping":
+                # the router's health cadence doubles as the worker's
+                # metrics-timeline sampler: one row per ping
+                fleet.metrics.sample_timeline()
                 reply({
                     "type": "pong",
                     "t": msg.get("t"),
                     "service_estimate": fleet.scheduler.service_estimate,
                 })
             elif kind == "stats":
+                # a stats round-trip always carries a fresh timeline row
+                # (pings drive the steady cadence; an early status() call
+                # must not render an empty worker timeline)
+                fleet.metrics.sample_timeline()
+                shipped = []
+                spans_dropped = 0
+                if tracer is not None:
+                    fresh, span_cursor[0] = tracer.spans_since(
+                        span_cursor[0]
+                    )
+                    # bounded shipping: a stats reply must stay a small
+                    # frame even after a long untapped tracing window —
+                    # overflow is DROPPED, but counted, never silent
+                    spans_dropped = max(0, len(fresh) - 4096)
+                    if spans_dropped:
+                        _flight.record_instant(
+                            "trace.spans_dropped", n=spans_dropped,
+                            worker=worker_id,
+                        )
+                    shipped = wire_spans(
+                        fresh[-4096:], tracer.epoch, tracer.epoch_unix,
+                        process_name=process_name,
+                    )
+                    # the router now owns these spans — discarding them
+                    # keeps an always-on traced worker's registry
+                    # bounded by the stats cadence, not the uptime
+                    tracer.discard_through(span_cursor[0])
                 reply({
                     "type": "stats",
                     "worker": worker_id,
                     "seq": msg.get("seq"),
                     "snapshot": fleet.metrics.snapshot(sketches=True),
+                    "spans": shipped,
+                    "spans_dropped": spans_dropped,
                 })
             elif kind == "stop":
                 fleet.shutdown(drain=bool(msg.get("drain", True)))

@@ -147,6 +147,18 @@ class ExecutableCache:
                     "aot cache: transient read fault for %s — degrading "
                     "to a miss", key,
                 )
+                # the recovery instant for the aot.read fault site (lint
+                # rule 4): the degrade-to-miss verdict must be visible in
+                # a flight dump / trace, not only in the log stream
+                from ..obs import flight as _flight
+                from ..obs.tracer import current as _trace_current
+
+                _flight.record_instant("aot.read_degraded", key=key)
+                tracer = _trace_current()
+                if tracer is not None:
+                    tracer.instant(
+                        "aot.read_degraded", op_type="AotCache", key=key
+                    )
                 return None
             raise
         path = self.entry_path(key)

@@ -350,8 +350,14 @@ def fault_point(site: str, **attrs) -> None:
         "fault injected: site=%s kind=%s attrs=%s", site, kind, attrs
     )
     try:
+        from ..obs import flight as _flight
         from ..obs.tracer import current as _trace_current
 
+        # the always-on flight ring gets every injection — a post-mortem
+        # dump must show the chaos schedule's hits even with tracing off
+        _flight.record_instant(
+            "fault.inject", site=site, kind=kind, **attrs
+        )
         tracer = _trace_current()
         if tracer is not None:
             tracer.instant(

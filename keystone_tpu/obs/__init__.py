@@ -12,7 +12,17 @@ Enable with ``KEYSTONE_TRACE=/path/trace.json`` (or the CLI's
 """
 
 from .audit import cache_audit, log_cache_audit
-from .export import format_top_spans, to_chrome_trace, write_chrome_trace
+from .context import Sampler, TraceContext, new_trace_id, sample_rate
+from .export import (
+    format_top_spans,
+    stitch_chrome_trace,
+    to_chrome_trace,
+    wire_spans,
+    write_chrome_trace,
+    write_stitched_trace,
+)
+from .flight import FlightRecorder, SITE_INSTANTS
+from .flight import recorder as flight_recorder
 from .scan import SCAN_LANE_SPAN, SCAN_SPAN, record_scan_span
 from .span import Span, cheap_nbytes
 from .tracer import Tracer, current, export, install, reset, start, stop, suspended
@@ -20,20 +30,30 @@ from .tracer import Tracer, current, export, install, reset, start, stop, suspen
 __all__ = [
     "SCAN_LANE_SPAN",
     "SCAN_SPAN",
+    "SITE_INSTANTS",
+    "FlightRecorder",
+    "Sampler",
     "Span",
+    "TraceContext",
     "Tracer",
     "cache_audit",
     "cheap_nbytes",
     "current",
+    "flight_recorder",
+    "new_trace_id",
     "record_scan_span",
     "export",
     "format_top_spans",
     "install",
     "log_cache_audit",
     "reset",
+    "sample_rate",
     "start",
+    "stitch_chrome_trace",
     "stop",
     "suspended",
     "to_chrome_trace",
+    "wire_spans",
     "write_chrome_trace",
+    "write_stitched_trace",
 ]

@@ -1,0 +1,112 @@
+"""Cross-process trace propagation: the context one request carries.
+
+The tracer (``obs/tracer.py``) gives each PROCESS a span registry; this
+module is what lets ONE request keep its identity while it crosses the
+process tier — ClusterRouter → wire → worker → replica. A
+:class:`TraceContext` is deliberately tiny (an id, the emitting hop, a
+wall-clock send stamp) because it rides on every ``req`` wire frame of a
+sampled request:
+
+* ``trace_id`` — globally unique per admitted request, namespaced by the
+  ORIGINATING process's pid (``"<pid-hex>-<seq-hex>"``) so two routers
+  sharing a machine can never mint colliding ids, and so the stitched
+  export can merge span sets from N processes without id collisions.
+* ``hop`` — the name of the span that emitted the context (the parent
+  hop), so a receiver can attribute its own spans under the right edge.
+* ``sent_unix`` — ``time.time()`` at send. Monotonic clocks are
+  process-local and useless on the wire; the unix clock is shared by
+  every process on the host, so the receiver computes the TRANSPORT
+  component of latency as ``time.time() - sent_unix`` — real queueing in
+  the kernel socket buffers plus scheduler delay, attributed to the hop
+  it belongs to instead of smeared into worker-side compute.
+
+Sampling (the overhead contract): ``KEYSTONE_TRACE_SAMPLE`` is the
+per-request trace sampling rate (default 1.0 — every request of a traced
+run). Production deployments that leave tracing always-on cap its cost by
+sampling down: at rate r the per-request cost is r × (a handful of span
+dataclasses + one extra dict on the wire frame) and exactly 0 for
+unsampled requests (one modulo check at admission). The FLIGHT RECORDER
+(``obs/flight.py``) deliberately ignores sampling — its ring records
+every request's summary regardless, so post-mortems never depend on a
+sampling coin flip.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+def sample_rate() -> float:
+    """The ``KEYSTONE_TRACE_SAMPLE`` per-request trace sampling rate,
+    clamped to [0, 1] (default 1.0: trace every request)."""
+    from ..utils import env_float
+
+    return min(1.0, env_float("KEYSTONE_TRACE_SAMPLE", 1.0, minimum=0.0))
+
+
+class Sampler:
+    """Deterministic every-Nth request sampling at ``rate``: request k is
+    sampled iff ``k % round(1/rate) == 0``. Deterministic on purpose —
+    a bench comparing traced vs untraced runs must sample the SAME
+    request positions both times, and a test asserting "rate 0.25 traces
+    1 in 4" must not flap on an RNG. Not thread-safe by design: callers
+    draw under their admission lock (the router does)."""
+
+    def __init__(self, rate: Optional[float] = None):
+        self.rate = sample_rate() if rate is None else float(rate)
+        self._every = (
+            0 if self.rate <= 0.0 else max(1, int(round(1.0 / self.rate)))
+        )
+        self._seq = 0
+
+    def admit(self) -> bool:
+        """One per-request decision (count + verdict)."""
+        if not self._every:
+            return False
+        k = self._seq
+        self._seq += 1
+        return k % self._every == 0
+
+
+@dataclass
+class TraceContext:
+    """One request's cross-process identity (see module docstring)."""
+
+    trace_id: str
+    hop: Optional[str] = None
+    sent_unix: Optional[float] = None
+
+    def to_wire(self) -> dict:
+        """The wire form, stamped with the send time NOW — serialize is
+        part of the hop, so the stamp happens as late as possible."""
+        return {
+            "id": self.trace_id,
+            "hop": self.hop,
+            "sent_unix": time.time(),
+        }
+
+    @staticmethod
+    def from_wire(enc: Optional[dict]) -> Optional["TraceContext"]:
+        if not enc or not enc.get("id"):
+            return None
+        return TraceContext(
+            trace_id=str(enc["id"]),
+            hop=enc.get("hop"),
+            sent_unix=enc.get("sent_unix"),
+        )
+
+    def transport_seconds(self) -> Optional[float]:
+        """Wire transport + receiver wakeup since the sender stamped this
+        context (clamped at 0: the unix clock can step backwards under
+        NTP, and a negative transport would corrupt hop sums)."""
+        if self.sent_unix is None:
+            return None
+        return max(0.0, time.time() - float(self.sent_unix))
+
+
+def new_trace_id(seq: int) -> str:
+    """A process-namespaced trace id (pid-hex + sequence-hex)."""
+    return f"{os.getpid():x}-{seq:x}"

@@ -1,18 +1,31 @@
-"""Trace exporters: Chrome-trace JSON and a plain-text top-N summary.
+"""Trace exporters: Chrome-trace JSON, cross-process stitching, and a
+plain-text top-N summary.
 
 The JSON form is the ``chrome://tracing`` / Perfetto "Trace Event Format"
 (https://ui.perfetto.dev opens it directly): one ``"X"`` complete event
 per span (``ts``/``dur`` in microseconds, rebased to the tracer's epoch),
 ``"i"`` instant events for cache hits, and ``"M"`` metadata events naming
-threads. Events are sorted by ``ts`` so consumers that stream (and
-``bin/trace-smoke.sh``'s monotonicity check) see ordered time.
+the process and its threads — every export carries a ``process_name``
+metadata event and its real ``pid``, so multi-process traces render as
+DISTINCT process tracks instead of flattening into one. Events are
+sorted by ``ts`` so consumers that stream (and ``bin/trace-smoke.sh``'s
+monotonicity check) see ordered time.
+
+Cross-process stitching (:func:`stitch_chrome_trace`): each process
+serializes its spans with :func:`wire_spans` — rebased onto the shared
+unix clock, because perf_counter epochs are process-local — and the
+router merges N processes' span sets into ONE document with per-pid
+process tracks. Span identity never collides across the merge: events
+carry no raw span ids, and the ``trace_id`` attr that ties one request's
+hops together is already namespaced by the originating pid
+(``obs/context.py``).
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from .tracer import Tracer
 
@@ -23,25 +36,56 @@ def _json_safe(v):
     return str(v)
 
 
-def to_chrome_trace(tracer: Tracer) -> Dict[str, object]:
+def _span_args(sp) -> dict:
+    """One span's exported args dict (typed fields + free-form attrs)."""
+    args = {
+        k: _json_safe(v)
+        for k, v in (
+            ("node", sp.node_id),
+            ("op_type", sp.op_type),
+            ("cache", sp.cache),
+            ("sync_ms", round(sp.sync_seconds * 1e3, 3) or None),
+            ("output_bytes", sp.output_bytes),
+            ("compiles", sp.compiles or None),
+        )
+        if v is not None
+    }
+    args.update({k: _json_safe(v) for k, v in sp.attrs.items()})
+    return args
+
+
+def _process_meta(pid: int, process_name: Optional[str]) -> List[dict]:
+    if not process_name:
+        return []
+    return [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "ts": 0.0,
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process_name},
+        }
+    ]
+
+
+def default_process_name() -> str:
+    """``keystone:<argv0-basename>/<pid>`` — distinct per process even
+    when every tier runs the same entry point."""
+    import sys
+
+    base = os.path.basename(sys.argv[0] or "python") or "python"
+    return f"keystone:{base}/{os.getpid()}"
+
+
+def to_chrome_trace(
+    tracer: Tracer, process_name: Optional[str] = None
+) -> Dict[str, object]:
     """The trace as a Chrome-trace dict: ``{"traceEvents": [...], ...}``."""
     pid = os.getpid()
     events: List[dict] = []
     thread_names = {}
     for sp in tracer.spans():
-        args = {
-            k: _json_safe(v)
-            for k, v in (
-                ("node", sp.node_id),
-                ("op_type", sp.op_type),
-                ("cache", sp.cache),
-                ("sync_ms", round(sp.sync_seconds * 1e3, 3) or None),
-                ("output_bytes", sp.output_bytes),
-                ("compiles", sp.compiles or None),
-            )
-            if v is not None
-        }
-        args.update({k: _json_safe(v) for k, v in sp.attrs.items()})
         ev = {
             "name": sp.name,
             "cat": "keystone",
@@ -49,7 +93,7 @@ def to_chrome_trace(tracer: Tracer) -> Dict[str, object]:
             "ts": round((sp.start - tracer.epoch) * 1e6, 3),
             "pid": pid,
             "tid": sp.tid,
-            "args": args,
+            "args": _span_args(sp),
         }
         if sp.instant:
             ev["s"] = "t"  # thread-scoped instant marker
@@ -58,7 +102,7 @@ def to_chrome_trace(tracer: Tracer) -> Dict[str, object]:
         events.append(ev)
         thread_names.setdefault(sp.tid, sp.thread_name)
     events.sort(key=lambda e: e["ts"])
-    meta = [
+    meta = _process_meta(pid, process_name or default_process_name()) + [
         {
             "name": "thread_name",
             "ph": "M",
@@ -82,6 +126,112 @@ def to_chrome_trace(tracer: Tracer) -> Dict[str, object]:
 def write_chrome_trace(tracer: Tracer, path: str) -> str:
     with open(path, "w") as f:
         json.dump(to_chrome_trace(tracer), f)
+    return path
+
+
+# -- cross-process stitching --------------------------------------------------
+
+
+def wire_spans(
+    spans: Iterable, epoch: float, epoch_unix: float,
+    pid: Optional[int] = None, process_name: Optional[str] = None,
+) -> List[dict]:
+    """Serialize spans for shipping across a process boundary: start
+    times rebased from the process-local perf_counter epoch onto the
+    HOST-shared unix clock (``epoch_unix + (start - epoch)``), plus the
+    pid/thread identity the stitcher needs for per-process tracks. The
+    wire form is plain JSON-safe dicts (they ride pickled stats replies
+    today, but nothing in them requires pickle)."""
+    pid = os.getpid() if pid is None else pid
+    out = []
+    for sp in spans:
+        out.append({
+            "name": sp.name,
+            "start_unix": epoch_unix + (sp.start - epoch),
+            "dur_s": sp.seconds,
+            "instant": bool(sp.instant),
+            "pid": pid,
+            "tid": sp.tid,
+            "thread_name": sp.thread_name,
+            "process_name": process_name,
+            "args": _span_args(sp),
+        })
+    return out
+
+
+def stitch_chrome_trace(
+    span_sets: Sequence[List[dict]],
+    base_unix: Optional[float] = None,
+) -> Dict[str, object]:
+    """Merge N processes' :func:`wire_spans` outputs into ONE
+    Chrome-trace document with real per-pid process tracks.
+
+    ``ts`` is rebased to ``base_unix`` (default: the earliest span seen)
+    so the merged timeline starts near 0. Each distinct pid contributes
+    its own ``process_name``/``thread_name`` metadata events — the fix
+    for the flattened single-process rendering the in-process exporter
+    used to produce for multi-process runs."""
+    all_spans = [s for spans in span_sets for s in spans]
+    if base_unix is None:
+        base_unix = min(
+            (s["start_unix"] for s in all_spans), default=0.0
+        )
+    events: List[dict] = []
+    proc_names: Dict[int, str] = {}
+    thread_names: Dict[Tuple[int, int], str] = {}
+    for s in all_spans:
+        pid = int(s.get("pid") or 0)
+        ev = {
+            "name": s["name"],
+            "cat": "keystone",
+            "ph": "i" if s.get("instant") else "X",
+            "ts": round((s["start_unix"] - base_unix) * 1e6, 3),
+            "pid": pid,
+            "tid": s.get("tid", 0),
+            "args": dict(s.get("args") or {}),
+        }
+        if s.get("instant"):
+            ev["s"] = "t"
+        else:
+            ev["dur"] = round(float(s.get("dur_s") or 0.0) * 1e6, 3)
+        events.append(ev)
+        if s.get("process_name"):
+            proc_names.setdefault(pid, str(s["process_name"]))
+        if s.get("thread_name"):
+            thread_names.setdefault(
+                (pid, s.get("tid", 0)), str(s["thread_name"])
+            )
+    events.sort(key=lambda e: e["ts"])
+    meta: List[dict] = []
+    for pid, name in sorted(proc_names.items()):
+        meta.extend(_process_meta(pid, name))
+    meta.extend(
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "ts": 0.0,
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": name},
+        }
+        for (pid, tid), name in sorted(thread_names.items())
+    )
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "keystone_tpu.obs (stitched)",
+            "epoch_unix_seconds": base_unix,
+            "processes": sorted(proc_names.values()),
+        },
+    }
+
+
+def write_stitched_trace(
+    span_sets: Sequence[List[dict]], path: str
+) -> str:
+    with open(path, "w") as f:
+        json.dump(stitch_chrome_trace(span_sets), f)
     return path
 
 

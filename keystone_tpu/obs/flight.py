@@ -1,0 +1,263 @@
+"""The always-on flight recorder: a bounded ring of recent evidence.
+
+The tracer (``obs/tracer.py``) is opt-in and unbounded — perfect for a
+profiling run, useless for the failure that happens at 3am with tracing
+off. The :class:`FlightRecorder` is the complement: ALWAYS on (no env
+knob gates recording; ``KEYSTONE_TRACE_SAMPLE`` does not apply), a
+fixed-size ring of recent span summaries and fault/trace instants whose
+per-record cost is one small dict + one deque append under a lock, and an
+atomic JSON dump fired by the supervision paths when something actually
+goes wrong — so every chaos event leaves a post-mortem artifact holding
+the last N things the process did before the event.
+
+What lands in the ring:
+
+* **span summaries** — one dict per completed unit of work the hot paths
+  already account for: a replica micro-batch (``serve.replica``), a
+  router request round-trip (``rpc.request``), a fleet swap, a trainer
+  refit. NOT full spans: no tree, no sync targets — name, seconds, and
+  the few attrs a post-mortem needs.
+* **instants** — fault injections (``fault.inject``), supervision events
+  (``fault.replica_down``, ``fault.worker_down``, restarts), trainer
+  verdicts (``trainer.rollback``, ``trainer.park``), SLO breaches
+  (``slo.breach``).
+
+Dump triggers (wired into the supervisors, see the callers): replica
+quarantine, worker death/respawn, canary rollback, trainer batch park,
+and SIGQUIT (:func:`install_sigquit_dump`). Dumps are atomic (tmp file +
+``os.replace``) into ``KEYSTONE_FLIGHT_DIR`` (default: the system temp
+dir) and never raise into the supervision path that triggered them.
+
+``SITE_INSTANTS`` is the observability contract the invariant lint
+(``tools/lint_invariants.py`` rule 4) enforces: every fault site
+registered in ``faults/plan.py`` must map here to the recovery instant
+its handling path emits — adding a new chaos site without declaring (and
+emitting) its post-mortem marker fails CI.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: fault site (faults/plan.py constant value) -> the recovery/handling
+#: instant its supervision path emits. Sites may share an instant (the
+#: scan retry discipline covers both scan seams). Lint rule 4 checks
+#: (a) every registered site has an entry and (b) every named instant is
+#: actually emitted somewhere under keystone_tpu/.
+SITE_INSTANTS = {
+    "scan.chunk": "retry.attempt",
+    "scan.stage": "retry.attempt",
+    "replica.batch": "fault.replica_down",
+    "aot.read": "aot.read_degraded",
+    "worker.spawn": "fault.worker_restart",
+    "trainer.ingest": "trainer.ingest_fault",
+    "trainer.absorb": "trainer.park",
+    "trainer.canary": "trainer.rollback",
+}
+
+#: ring capacity default; KEYSTONE_FLIGHT_RING overrides at first use
+_DEFAULT_RING = 512
+
+
+def _ring_size() -> int:
+    from ..utils import env_int
+
+    return env_int("KEYSTONE_FLIGHT_RING", _DEFAULT_RING)
+
+
+def _dump_dir() -> str:
+    from ..utils import env_str
+
+    return env_str("KEYSTONE_FLIGHT_DIR") or tempfile.gettempdir()
+
+
+class FlightRecorder:
+    """A lock-cheap bounded ring of span summaries + instants."""
+
+    def __init__(self, ring: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=ring or _ring_size())
+        self._dumps = 0
+        self._dropped = 0  # records displaced by the bound (ring churn)
+
+    # -- writes ----------------------------------------------------------
+
+    def record_span(self, name: str, seconds: float, **attrs) -> None:
+        """One completed-work summary. ``attrs`` must be JSON-scalar-ish
+        (the dump stringifies anything that is not)."""
+        entry = {
+            "t": time.time(),
+            "kind": "span",
+            "name": name,
+            "seconds": round(float(seconds), 6),
+        }
+        if attrs:
+            entry["attrs"] = attrs
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(entry)
+
+    def record_instant(self, name: str, **attrs) -> None:
+        entry = {"t": time.time(), "kind": "instant", "name": name}
+        if attrs:
+            entry["attrs"] = attrs
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(entry)
+
+    # -- reads -----------------------------------------------------------
+
+    def entries(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- the dump --------------------------------------------------------
+
+    def dump(
+        self, trigger: str, path: Optional[str] = None
+    ) -> Optional[str]:
+        """Write the ring atomically as JSON; returns the path, or None
+        on failure (logged — a post-mortem write must never take down
+        the supervision path that triggered it).
+
+        Signal-safe enough for the SIGQUIT handler: the ring lock is
+        taken with a timeout because the handler may interrupt the MAIN
+        thread inside a record_* call already holding it — blocking
+        there would wedge the process the dump exists to explain. An
+        unlocked read of the deque is best-effort (a concurrent append
+        can fault the copy; the dump then ships what it got)."""
+        locked = self._lock.acquire(timeout=1.0)
+        try:
+            try:
+                entries = list(self._ring)
+            except RuntimeError:
+                # lock-less fallback raced a writer mid-mutation
+                entries = []
+            self._dumps += 1
+            seq = self._dumps
+            dropped = self._dropped
+        finally:
+            if locked:
+                self._lock.release()
+        doc = {
+            "producer": "keystone_tpu.obs.flight",
+            "trigger": trigger,
+            "pid": os.getpid(),
+            "host_unix": time.time(),
+            "ring_capacity": self._ring.maxlen,
+            "dropped_before_window": dropped,
+            "entries": entries,
+        }
+        if path is None:
+            path = os.path.join(
+                _dump_dir(),
+                f"keystone-flight-{os.getpid()}-{trigger}-{seq}.json",
+            )
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)  # atomic: readers never see a torn file
+        except OSError:
+            logger.warning(
+                "flight recorder: dump to %s failed", path, exc_info=True
+            )
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # lint: allow-silent -- tmp may never have been created
+            return None
+        logger.warning(
+            "flight recorder: %d entries -> %s (trigger: %s)",
+            len(entries), path, trigger,
+        )
+        return path
+
+
+# -- process-global wiring ----------------------------------------------------
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+_sigquit_installed = False
+
+
+def recorder() -> FlightRecorder:
+    """THE process flight recorder (created on first use — recording is
+    always on, so there is nothing to install)."""
+    global _recorder
+    rec = _recorder
+    if rec is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+            rec = _recorder
+    return rec
+
+
+def reset() -> None:
+    """Drop the process recorder (test hygiene: a fresh bounded window)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None
+
+
+def record_span(name: str, seconds: float, **attrs) -> None:
+    recorder().record_span(name, seconds, **attrs)
+
+
+def record_instant(name: str, **attrs) -> None:
+    recorder().record_instant(name, **attrs)
+
+
+def dump(trigger: str, path: Optional[str] = None) -> Optional[str]:
+    return recorder().dump(trigger, path=path)
+
+
+def install_sigquit_dump() -> bool:
+    """SIGQUIT → flight dump (then the previous handler, so the default
+    core-dump behavior is preserved). Returns False outside the main
+    thread (signal registration is main-thread-only) or when already
+    installed."""
+    import signal
+
+    global _sigquit_installed
+    if _sigquit_installed:
+        return False
+
+    prev = None
+
+    def _on_quit(signum, frame):
+        # the dump is file IO — bounded, reentrancy-safe enough for a
+        # handler that by definition fires when the operator asked for
+        # evidence; the previous behavior still runs after
+        dump("sigquit")
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # restore and re-raise so the DEFAULT terminate/core-dump
+            # behavior is genuinely preserved (SIG_DFL is not callable —
+            # returning here would swallow the operator's kill)
+            signal.signal(signal.SIGQUIT, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGQUIT)
+
+    try:
+        prev = signal.signal(signal.SIGQUIT, _on_quit)
+    except ValueError:
+        return False  # non-main thread (embedded use)
+    _sigquit_installed = True
+    return True

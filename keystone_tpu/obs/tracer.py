@@ -90,6 +90,9 @@ class Tracer:
         #: are small per-graph ints, so a long-lived tracer must not merge
         #: a NEW pass's estimate for id "3" into a PREVIOUS pipeline's row
         self._plan_epoch = 0
+        #: spans discarded below _spans[0] (discard_through): cursors
+        #: from spans_since stay valid GLOBAL indices across compaction
+        self._span_offset = 0
         _install_compile_listener()
 
     # -- span recording -------------------------------------------------
@@ -222,6 +225,32 @@ class Tracer:
     def spans(self) -> List[Span]:
         with self._lock:
             return list(self._spans)
+
+    def spans_since(self, cursor: int):
+        """``(spans[cursor:], new_cursor)`` — the incremental read the
+        cluster worker uses to ship each recorded span back to the
+        router exactly once. Cursors are GLOBAL indices (monotonic
+        across :meth:`discard_through` compaction), so a bookmark taken
+        before a discard still resolves to only-unshipped spans."""
+        with self._lock:
+            n = self._span_offset + len(self._spans)
+            start = max(cursor - self._span_offset, 0)
+            return self._spans[start:], n
+
+    def discard_through(self, cursor: int) -> int:
+        """Drop spans below global index ``cursor`` (they were shipped to
+        another process that now owns them). This is what keeps a
+        long-lived ALWAYS-ON traced worker bounded: without it the
+        append-only registry grows one Span per hop forever. Returns the
+        count discarded. Local reads (``spans()``/``span_summary``) see
+        only the retained window afterwards — the shipper is the
+        archive."""
+        with self._lock:
+            k = min(max(cursor - self._span_offset, 0), len(self._spans))
+            if k:
+                del self._spans[:k]
+                self._span_offset += k
+            return k
 
     def span_summary(
         self, prefix: Optional[str] = None

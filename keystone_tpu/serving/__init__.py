@@ -40,8 +40,12 @@ from .fleet import ServingFleet
 from .metrics import MetricsRegistry
 from .replica import Replica
 from .scheduler import FleetScheduler
+from .slo import SloBreach, SloPolicy, SloWatchdog
 
 __all__ = [
+    "SloBreach",
+    "SloPolicy",
+    "SloWatchdog",
     "ServingEngine",
     "ServingFleet",
     "Replica",

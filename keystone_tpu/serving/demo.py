@@ -89,6 +89,13 @@ def _serve_through_cluster(args, fitted, data, buckets) -> int:
             ))
         snap = router.snapshot()
         reports = [r for r in router.worker_reports if r]
+        if args.status:
+            from ..cluster import format_status
+
+            # the fleet-wide timeline view: per-process metrics
+            # timelines, worker liveness/restart budgets, SLO verdicts
+            # (reuses the snapshot above — one stats round-trip, not two)
+            print(format_status(router.status(snap=snap)))
     expected = (
         np.asarray(fitted.apply(data).to_array())
         if len(data) else np.array([])
@@ -159,6 +166,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--maxWaitMs", type=float, default=2.0)
     p.add_argument("--clients", type=int, default=8,
                    help="concurrent submitter threads")
+    p.add_argument(
+        "--status", action="store_true",
+        help="with --workers N: print the fleet-wide status/timeline "
+             "view (ClusterRouter.status() rendered — per-process "
+             "metrics timelines, worker liveness, SLO verdicts) after "
+             "the traffic drains",
+    )
     p.add_argument(
         "--expect-zero-compiles", action="store_true",
         dest="expect_zero_compiles",

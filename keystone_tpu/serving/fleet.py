@@ -55,6 +55,7 @@ from concurrent.futures import Future
 from typing import Any, List, Optional, Sequence
 
 from ..faults import ReplicaKilled
+from ..obs import flight as _flight
 from ..obs.tracer import current as _trace_current
 from ..workflow.pipeline import FittedPipeline
 from .batching import BucketPolicy
@@ -470,6 +471,10 @@ class ServingFleet:
                     replica=rep.index, kind=kind, requeued=moved,
                     restarting=will_restart,
                 )
+            _flight.record_instant(
+                "fault.replica_down", replica=rep.index, kind=kind,
+                requeued=moved, restarting=will_restart,
+            )
             if will_restart:
                 self._restart_counts[rep.index] = used + 1
                 self._metrics.inc("restarts")
@@ -491,10 +496,21 @@ class ServingFleet:
                         "fleet: no live replicas remain — failed %d "
                         "queued request(s)", failed,
                     )
+        # post-mortem artifacts, OUTSIDE the supervise lock (dumping is
+        # file IO): quarantine always leaves one; so does a replica that
+        # exhausted its restart budget (the fleet just lost capacity)
+        if quarantined:
+            _flight.dump("replica_quarantine")
+        elif not will_restart:
+            _flight.dump("replica_down")
         if will_restart:
             # spawn OUTSIDE the supervise lock (it re-takes it to
             # register the thread)
             self._spawn_replica_thread(rep)
+            _flight.record_instant(
+                "fault.replica_restart", replica=rep.index,
+                attempt=used + 1,
+            )
             tracer = _trace_current()
             if tracer is not None:
                 tracer.instant(
@@ -576,18 +592,28 @@ class ServingFleet:
 
     # -- admission -------------------------------------------------------
 
-    def submit(self, datum: Any, timeout: Optional[float] = None) -> Future:
+    def submit(
+        self,
+        datum: Any,
+        timeout: Optional[float] = None,
+        trace: Any = None,
+    ) -> Future:
         """Enqueue one datum; returns a Future of its prediction row.
 
         ``timeout`` (seconds) is the request's deadline. Raises typed:
         :class:`QueueFull` at capacity, :class:`Shed` when the deadline
         cannot be met given the learned service time and queue depth,
-        :class:`EngineStopped` after shutdown."""
+        :class:`EngineStopped` after shutdown. ``trace`` is an optional
+        :class:`~keystone_tpu.obs.context.TraceContext` — a sampled
+        request's cross-process identity, carried so the replica's
+        queue-wait and batch spans record under it (the cluster worker
+        passes the context it received off the wire)."""
         now = time.monotonic()
         req = _Request(
             datum=datum,
             deadline=(now + timeout) if timeout is not None else None,
             enqueued=now,
+            trace=trace,
         )
         self._scheduler.admit(req)  # counts "submitted" atomically
         return req.future
@@ -727,6 +753,10 @@ class ServingFleet:
                 "canary": canary_report,
                 "version": version,
             }
+            _flight.record_instant(
+                "serve.swap", version=version,
+                replicas=len(self._replicas), buckets_warmed=warmed,
+            )
             tracer = _trace_current()
             if tracer is not None:
                 with tracer.span(
@@ -805,6 +835,13 @@ class ServingFleet:
                      f"{max_latency_ratio}"
             )
             logger.warning("fleet canary FAILED — rolling back: %s", why)
+            _flight.record_instant(
+                "serve.canary_rollback",
+                mismatches=report["mismatches"],
+                batches_compared=report["batches_compared"],
+                latency_ratio=ratio,
+            )
+            _flight.dump("canary_rollback")
             raise CanaryMismatch(
                 f"canary auto-rollback: {why}; the fleet is still serving "
                 "the previous model",

@@ -49,7 +49,12 @@ _QUANTILES = (0.50, 0.95, 0.99)
 class MetricsRegistry:
     """Thread-safe counters + gauges + a bounded latency reservoir."""
 
-    def __init__(self, name: str = "serving", latency_window: int = 4096):
+    def __init__(
+        self,
+        name: str = "serving",
+        latency_window: int = 4096,
+        timeline_window: int = 256,
+    ):
         self.name = name
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = defaultdict(int)
@@ -61,6 +66,11 @@ class MetricsRegistry:
         # replica index -> [items, capacity, batches]: per-replica
         # occupancy for the fleet (one registry, N replica workers)
         self._replica_batches: Dict[int, list] = {}
+        #: the bounded metrics timeline: one row per sample_timeline()
+        #: call (the health/periodic loops drive the cadence) — the
+        #: queue-age-over-time view a point-in-time snapshot cannot give
+        self._timeline: deque = deque(maxlen=timeline_window)
+        self._timeline_prev: Dict[str, int] = {}
 
     # -- writes ---------------------------------------------------------
 
@@ -135,6 +145,58 @@ class MetricsRegistry:
             out[f"p{int(q * 100)}"] = vals[idx]
         return out
 
+    # -- the timeline ---------------------------------------------------
+
+    def sample_timeline(self, now: Optional[float] = None) -> Dict[str, object]:
+        """Append one ``(ts, counter deltas, gauges, quantiles,
+        occupancy)`` row to the bounded timeline ring and return it.
+
+        Counters land as DELTAS since the previous sample (a timeline of
+        cumulative totals only ever goes up and hides the burst), so a
+        row reads as "what happened in this window"; quantiles are the
+        reservoir's current view. Callers drive the cadence — the
+        cluster router's health loop, the worker's ping handler — so one
+        registry never pays two samplers."""
+        import time as _time
+
+        ts = _time.time() if now is None else float(now)
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = list(self._gauges.items())
+            items, capacity = self._batch_items, self._batch_capacity
+            prev = self._timeline_prev
+            deltas = {
+                k: v - prev.get(k, 0)
+                for k, v in counters.items()
+                if v - prev.get(k, 0)
+            }
+            self._timeline_prev = counters
+        gauge_vals = {}
+        for k, read in gauges:
+            try:
+                v = read()
+            except Exception:
+                logger.debug("timeline gauge %s failed", k, exc_info=True)
+                continue
+            if isinstance(v, (int, float)):
+                gauge_vals[k] = round(float(v), 6)
+        row: Dict[str, object] = {
+            "ts": ts,
+            "counters": deltas,
+            "gauges": gauge_vals,
+            "latency": self.latency_quantiles(),
+            "queue_age": self.queue_age_quantiles(),
+            "occupancy": (items / capacity) if capacity else None,
+        }
+        with self._lock:
+            self._timeline.append(row)
+        return row
+
+    def timeline(self) -> list:
+        """The bounded sample rows, oldest first."""
+        with self._lock:
+            return [dict(r) for r in self._timeline]
+
     def snapshot(self, sketches: bool = False) -> Dict[str, object]:
         """Everything at once: counters, evaluated gauges, occupancy,
         latency quantiles, and the process phase-timing table.
@@ -181,6 +243,10 @@ class MetricsRegistry:
             "queue_age": self.queue_age_quantiles(),
             "phases": timing.snapshot(prefix="serve."),
             "spans": self._span_summary(),
+            # the bounded timeline rides every snapshot (cheap: <=
+            # timeline_window small dicts) so a worker's rows cross the
+            # wire with its stats reply and survive the merge intact
+            "timeline": self.timeline(),
         }
         if sketch is not None:
             snap["sketch"] = sketch
@@ -212,6 +278,7 @@ class MetricsRegistry:
         ages: list = []
         phases: Dict[str, Dict[str, float]] = {}
         spans: Dict[str, Dict[str, float]] = {}
+        timelines: Dict[str, list] = {}
 
         def _fold_table(dst, src):
             for key, row in (src or {}).items():
@@ -241,6 +308,13 @@ class MetricsRegistry:
             ages.extend(sketch.get("queue_ages") or [])
             _fold_table(phases, snap.get("phases"))
             _fold_table(spans, snap.get("spans"))
+            # timelines stay PER-PROCESS, never blended: each row is one
+            # process's windowed view, and summing two processes' p99
+            # columns (or interleaving their delta rows) would fabricate
+            # a timeline no process ever observed
+            rows = snap.get("timeline")
+            if rows:
+                timelines[label] = [dict(r) for r in rows]
         return {
             "name": name,
             "merged_from": len(list(snapshots)),
@@ -256,6 +330,7 @@ class MetricsRegistry:
             "queue_age": MetricsRegistry._quantiles(sorted(ages)),
             "phases": {k: dict(v) for k, v in phases.items()},
             "spans": {k: dict(v) for k, v in spans.items()},
+            "timelines": timelines,
         }
 
     @staticmethod

@@ -44,6 +44,8 @@ from ..faults import (
     fault_point,
     is_transient,
 )
+from ..obs import flight as _flight
+from ..obs.span import Span
 from ..obs.tracer import current as _trace_current
 from ..utils import timing
 from ..workflow.pipeline import FittedPipeline, NotTraceableError
@@ -107,6 +109,10 @@ class _Request:
     #: bounds the reroute loop for deadline-less requests, which the
     #: shed check can never retire
     hops: int = 0
+    #: cross-process trace context (obs/context.py) for a sampled
+    #: request: the replica records its queue-wait and batch spans under
+    #: this identity so one request's hops stitch across the tier
+    trace: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -426,6 +432,7 @@ class Replica:
                 raise _TransientBatchFault(e, list(batch)) from e
             raise
         now = time.monotonic()
+        tracer = _trace_current()
         live = []
         for r in batch:
             if not r.future.set_running_or_notify_cancel():
@@ -439,7 +446,25 @@ class Replica:
                     )
                 )
                 continue
-            self._metrics.observe_queue_age(now - r.enqueued)
+            queue_age = now - r.enqueued
+            self._metrics.observe_queue_age(queue_age)
+            if r.trace is not None and tracer is not None:
+                # the queue-wait hop of a traced request: a completed
+                # span backdated over the enqueued->dispatched window so
+                # the stitched cross-process trace shows WHERE the time
+                # went (queued here vs executing below)
+                end_pc = time.perf_counter()
+                tracer.record_complete(Span(
+                    name="serve.queue",
+                    start=end_pc - queue_age,
+                    end=end_pc,
+                    op_type="FleetScheduler",
+                    attrs={
+                        "trace_id": r.trace.trace_id,
+                        "replica": self.index,
+                        "queue_age_s": round(queue_age, 6),
+                    },
+                ))
             live.append(r)
 
         valid, rows = [], []
@@ -466,10 +491,18 @@ class Replica:
             # span name differs from the phase's "serve.batch" so a merged
             # {name: {seconds, calls, ...}} export of phases + spans never
             # collides on keys
-            tracer = _trace_current()
             span_attrs = {"items": len(valid), "bucket": bucket}
             if self.index is not None:
                 span_attrs["replica"] = self.index
+            traced_ids = [
+                r.trace.trace_id for r in valid if r.trace is not None
+            ]
+            if traced_ids:
+                # the batch span carries the first sampled member's
+                # identity; members 2..N get their OWN execution spans
+                # below (consumers group by args.trace_id, so every
+                # coalesced member must own a span over the interval)
+                span_attrs["trace_id"] = traced_ids[0]
             with contextlib.ExitStack() as stack:
                 sp = (
                     stack.enter_context(
@@ -502,6 +535,33 @@ class Replica:
             return 0
         self.last_exec_seconds = time.perf_counter() - t0
         self.consecutive_failures = 0
+        # the always-on flight ring gets every batch's summary — with
+        # tracing OFF this (one dict + deque append) is the whole
+        # observability cost of a batch, and it is what a post-mortem
+        # dump shows the replica doing in the seconds before a trigger
+        _flight.record_span(
+            self._span_name, self.last_exec_seconds,
+            items=len(valid), bucket=bucket, replica=self.index,
+        )
+        if len(traced_ids) > 1 and tracer is not None:
+            # coalesced traced members beyond the first: each owns an
+            # execution span over the shared batch interval, so per-
+            # trace-id grouping never loses a member's compute hop
+            # (capped — a full 64-bucket of sampled traffic must not
+            # 64x the span volume)
+            for extra_tid in traced_ids[1:16]:
+                tracer.record_complete(Span(
+                    name=self._span_name,
+                    start=t0,
+                    end=t0 + self.last_exec_seconds,
+                    op_type="Replica",
+                    attrs={
+                        "trace_id": extra_tid,
+                        "replica": self.index,
+                        "bucket": bucket,
+                        "coalesced": True,
+                    },
+                ))
 
         done = time.monotonic()
         for i, r in enumerate(valid):

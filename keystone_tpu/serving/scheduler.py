@@ -455,6 +455,7 @@ class FleetScheduler:
                 clone = _Request(
                     datum=req.datum, deadline=req.deadline,
                     enqueued=req.enqueued, hops=req.hops + 1,
+                    trace=req.trace,  # the retry keeps its identity
                 )
                 _chain_futures(clone.future, req.future)
                 self._queues[target].appendleft(clone)

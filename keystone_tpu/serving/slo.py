@@ -1,0 +1,186 @@
+"""Declarative SLO policy over the metrics timeline.
+
+The metrics timeline (``MetricsRegistry.sample_timeline``) turns the
+registry's point-in-time snapshot into rows of windowed evidence; this
+module is the judgment layer on top: a :class:`SloPolicy` names the
+budgets (p99 vs deadline budget, shed rate, restart-budget burn, trainer
+staleness/drift), :meth:`SloPolicy.evaluate` prices one row against
+them, and the :class:`SloWatchdog` runs that evaluation per sample —
+emitting each violation as a typed :class:`SloBreach` into
+
+* the **flight recorder** (``slo.breach`` instants — a breach is exactly
+  the kind of pre-failure evidence a post-mortem ring exists for),
+* the **trace** (when a tracer is installed),
+* the **metrics** (an ``slo_breaches`` counter plus per-objective
+  ``slo_breach.<objective>`` counters, so the periodic INFO line and the
+  merged cluster snapshot carry the burn), and
+* a rate-limited WARNING log.
+
+Every budget is Optional: an unset objective is not evaluated, so a
+policy names exactly the SLOs a deployment actually has. This is the
+observation substrate the ROADMAP's autoscaling item reads — "queue age
+approaching the deadline budget" is literally a breach row here.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class SloBreach:
+    """One objective violated by one timeline row."""
+
+    objective: str  # policy field name, e.g. "p99_budget_s"
+    observed: float
+    budget: float
+    ts: float
+
+    def as_attrs(self) -> Dict[str, object]:
+        return {
+            "objective": self.objective,
+            "observed": round(self.observed, 6),
+            "budget": self.budget,
+        }
+
+
+@dataclass
+class SloPolicy:
+    """Budgets per objective; None disables that objective.
+
+    p99_budget_s / queue_age_p99_budget_s:
+        End-to-end and time-queued p99 ceilings (seconds). Queue age is
+        the early-warning twin: it breaches before latency does.
+    max_shed_rate:
+        Ceiling on the share of OFFERED traffic refused within one
+        sample window: ``(shed + rejected) / (submitted + shed +
+        rejected)`` — admission surfaces count ``submitted`` only for
+        admitted requests, so the denominator reconstructs what was
+        offered. (A request shed AFTER admission on a requeue counts in
+        both terms, slightly diluting the rate; those windows also burn
+        ``max_restart_burn``, which is the objective that owns them.)
+        Windows with no traffic are not judged.
+    max_restart_burn:
+        Supervised restarts (replica + worker) tolerated per sample
+        window — restart-budget burn-RATE, distinct from the absolute
+        budgets the supervisors enforce: a fleet recovering this often
+        is failing its availability SLO even while every restart
+        succeeds.
+    max_staleness_s / max_drift_score:
+        Trainer-loop objectives over the ``staleness_s`` / ``drift_score``
+        gauges the daemon exports: a model too old, or drifting past the
+        monitor's threshold, is an SLO breach even when serving is fast.
+    """
+
+    p99_budget_s: Optional[float] = None
+    queue_age_p99_budget_s: Optional[float] = None
+    max_shed_rate: Optional[float] = None
+    max_restart_burn: Optional[int] = None
+    max_staleness_s: Optional[float] = None
+    max_drift_score: Optional[float] = None
+
+    def evaluate(self, row: Dict[str, object]) -> List[SloBreach]:
+        """Judge one ``sample_timeline`` row; returns the breaches (empty
+        when every set objective holds)."""
+        ts = float(row.get("ts") or time.time())
+        counters: Dict[str, int] = dict(row.get("counters") or {})
+        gauges: Dict[str, float] = dict(row.get("gauges") or {})
+        out: List[SloBreach] = []
+
+        def breach(objective: str, observed, budget) -> None:
+            out.append(SloBreach(objective, float(observed), float(budget), ts))
+
+        lat = row.get("latency") or {}
+        if (
+            self.p99_budget_s is not None
+            and lat.get("p99", 0.0) > self.p99_budget_s
+        ):
+            breach("p99_budget_s", lat["p99"], self.p99_budget_s)
+        age = row.get("queue_age") or {}
+        if (
+            self.queue_age_p99_budget_s is not None
+            and age.get("p99", 0.0) > self.queue_age_p99_budget_s
+        ):
+            breach(
+                "queue_age_p99_budget_s", age["p99"],
+                self.queue_age_p99_budget_s,
+            )
+        if self.max_shed_rate is not None:
+            submitted = counters.get("submitted", 0)
+            refused = counters.get("shed", 0) + counters.get("rejected", 0)
+            if submitted + refused > 0:
+                rate = refused / (submitted + refused)
+                if rate > self.max_shed_rate:
+                    breach("max_shed_rate", rate, self.max_shed_rate)
+        if self.max_restart_burn is not None:
+            burn = counters.get("restarts", 0) + counters.get(
+                "trainer_restarts", 0
+            )
+            if burn > self.max_restart_burn:
+                breach("max_restart_burn", burn, self.max_restart_burn)
+        if self.max_staleness_s is not None:
+            staleness = gauges.get("staleness_s")
+            if staleness is not None and staleness > self.max_staleness_s:
+                breach("max_staleness_s", staleness, self.max_staleness_s)
+        if self.max_drift_score is not None:
+            drift = gauges.get("drift_score")
+            if drift is not None and drift > self.max_drift_score:
+                breach("max_drift_score", drift, self.max_drift_score)
+        return out
+
+
+class SloWatchdog:
+    """Per-sample SLO evaluation bound to one registry.
+
+    ``tick()`` samples the registry's timeline and judges the fresh row;
+    the caller owns the cadence (the cluster router's health loop, a
+    fleet's periodic logging path). ``source`` labels the emitted
+    evidence so merged views attribute breaches to their tier."""
+
+    def __init__(
+        self,
+        metrics,
+        policy: SloPolicy,
+        source: str = "serving",
+    ):
+        self._metrics = metrics
+        self.policy = policy
+        self.source = source
+        self.breaches: List[SloBreach] = []  # bounded by _MAX_KEPT
+        self._MAX_KEPT = 256
+
+    def tick(self) -> List[SloBreach]:
+        row = self._metrics.sample_timeline()
+        found = self.policy.evaluate(row)
+        for b in found:
+            self._emit(b)
+        if found:
+            self.breaches.extend(found)
+            del self.breaches[: -self._MAX_KEPT]
+        return found
+
+    def _emit(self, b: SloBreach) -> None:
+        from ..obs import flight
+        from ..obs.tracer import current as _trace_current
+        from ..utils.obs import every
+
+        self._metrics.inc("slo_breaches")
+        self._metrics.inc(f"slo_breach.{b.objective}")
+        attrs = b.as_attrs()
+        flight.record_instant("slo.breach", source=self.source, **attrs)
+        tracer = _trace_current()
+        if tracer is not None:
+            tracer.instant(
+                "slo.breach", op_type=type(self).__name__,
+                source=self.source, **attrs,
+            )
+        if every(f"slo:{self.source}:{b.objective}", 10.0):
+            logger.warning(
+                "SLO breach [%s] %s: observed %.4f vs budget %.4f",
+                self.source, b.objective, b.observed, b.budget,
+            )

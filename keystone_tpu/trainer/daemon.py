@@ -345,6 +345,11 @@ class TrainerDaemon:
                 self._consecutive_ingest_failures,
                 self.max_ingest_failures, e,
             )
+            self._instant(
+                "trainer.ingest_fault",
+                consecutive=self._consecutive_ingest_failures,
+                budget=self.max_ingest_failures,
+            )
             if self._consecutive_ingest_failures >= self.max_ingest_failures:
                 raise
             return []
@@ -527,6 +532,11 @@ class TrainerDaemon:
             start, stop, why,
         )
         self._instant("trainer.park", batch_start=start, batch_stop=stop)
+        # a parked batch is quarantined data: leave the post-mortem
+        # artifact holding what the loop did on the way here
+        from ..obs import flight as _flight
+
+        _flight.dump("trainer_park")
 
     def _discard_checkpoint(self, attempt: _Attempt) -> None:
         """A parked batch's half-folded checkpoint must not survive: it
@@ -558,6 +568,12 @@ class TrainerDaemon:
         )
 
     def _instant(self, name: str, **attrs) -> None:
+        # every trainer verdict lands in the always-on flight ring too:
+        # a promote/rollback/park/restart must be visible in a post-
+        # mortem dump even when tracing was never configured
+        from ..obs import flight as _flight
+
+        _flight.record_instant(name, **attrs)
         tracer = _trace_current()
         if tracer is not None:
             tracer.instant(name, op_type=type(self).__name__, **attrs)

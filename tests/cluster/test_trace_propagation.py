@@ -1,0 +1,168 @@
+"""Cross-process trace propagation (ISSUE 15): a traced request through
+the real 2-worker router stitches into one span tree with per-hop
+wire/queue attribution and real per-pid process tracks.
+
+One module-scoped traced router serves every test (worker boots pay a
+fresh interpreter each); tests run in definition order and only ever ADD
+spans, so earlier traffic never invalidates a later assertion.
+"""
+
+import time
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from keystone_tpu.cluster import ClusterRouter
+from keystone_tpu.obs import tracer as trace_mod
+from keystone_tpu.obs.context import Sampler
+
+D = 32
+STALL_S = 0.002
+
+#: the hop span names each tier contributes to a stitched request
+ROUTER_HOPS = {"rpc.admission", "rpc.send", "rpc.request"}
+WORKER_HOPS = {"cluster.handle", "serve.queue", "serve.replica"}
+
+
+@pytest.fixture(scope="module")
+def tracer():
+    prev = trace_mod.stop()  # nothing else should be installed, but be safe
+    t = trace_mod.install(trace_mod.Tracer())
+    yield t
+    trace_mod.stop()
+    if prev is not None:
+        trace_mod.install(prev)
+
+
+@pytest.fixture(scope="module")
+def router(tracer):
+    r = ClusterRouter(
+        ("factory", "keystone_tpu.cluster.demo:build_stall_model",
+         {"d": D, "stall_s": STALL_S}),
+        workers=2,
+        replicas_per_worker=1,
+        buckets=(8,),
+        datum_shape=(D,),
+        max_wait_ms=1.0,
+        spawn_timeout_s=180,
+        # a fast health loop: worker pings drive their timeline sampling
+        health_interval_s=0.25,
+        drain_timeout_s=5.0,
+        join_timeout_s=3.0,
+    )
+    r.start()
+    yield r
+    r.shutdown(drain=False)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return np.random.RandomState(0).randn(16, D).astype(np.float32)
+
+
+def _events_by_trace(span_sets):
+    by_trace = defaultdict(list)
+    for spans in span_sets:
+        for s in spans:
+            tid = (s.get("args") or {}).get("trace_id")
+            if tid:
+                by_trace[tid].append(s)
+    return by_trace
+
+
+def test_a_one_request_stitches_across_three_hops(router, data):
+    router.predict(data[0], timeout=30.0)
+    span_sets = router.collect_trace(timeout=10.0)
+    by_trace = _events_by_trace(span_sets)
+    assert by_trace, "no trace ids propagated"
+    # pick a trace that has worker-side spans (the stats round-trip in
+    # collect_trace shipped them)
+    tid, spans = max(
+        by_trace.items(), key=lambda kv: len({s["name"] for s in kv[1]})
+    )
+    names = {s["name"] for s in spans}
+    pids = {s["pid"] for s in spans}
+    assert names >= ROUTER_HOPS | WORKER_HOPS, names
+    assert len(pids) >= 2, pids  # router + worker process tracks
+    # per-hop attribution: wire transport on the worker-residency hop,
+    # queue wait on the scheduler hop, reply transport on the round-trip
+    handle = next(s for s in spans if s["name"] == "cluster.handle")
+    assert handle["args"]["transport_s"] >= 0.0
+    queue = next(s for s in spans if s["name"] == "serve.queue")
+    assert queue["args"]["queue_age_s"] >= 0.0
+    rpc = next(s for s in spans if s["name"] == "rpc.request")
+    assert rpc["args"]["reply_transport_s"] >= 0.0
+    assert rpc["args"]["ok"] is True
+    # the round-trip bounds every hop: each hop fits inside it (unix
+    # clocks are shared on-host; 50ms slack absorbs clock fuzz)
+    lo = rpc["start_unix"] - 0.05
+    hi = rpc["start_unix"] + rpc["dur_s"] + 0.05
+    for s in spans:
+        assert lo <= s["start_unix"] <= hi, (s["name"], s, rpc)
+
+
+def test_b_stitched_export_has_process_tracks(router, data, tmp_path):
+    router.predict(data[1], timeout=30.0)
+    import json
+
+    path = router.export_trace(str(tmp_path / "stitched.json"))
+    doc = json.loads(open(path).read())
+    ev = doc["traceEvents"]
+    proc_meta = {
+        e["pid"]: e["args"]["name"]
+        for e in ev if e["name"] == "process_name"
+    }
+    # distinct pids: the router and both workers announce themselves
+    assert len(proc_meta) >= 3, proc_meta
+    assert any("router" in n for n in proc_meta.values())
+    assert sum("worker" in n for n in proc_meta.values()) >= 2
+    # thread metadata rides per process too
+    named_threads = {
+        (e["pid"], e["tid"]) for e in ev if e["name"] == "thread_name"
+    }
+    assert len({p for p, _ in named_threads}) >= 2
+    ts = [e["ts"] for e in ev]
+    assert all(b >= a for a, b in zip(ts, ts[1:])), "non-monotonic ts"
+    assert all(e["ts"] >= 0.0 for e in ev)
+
+
+def test_c_status_renders_per_process_timelines(router, data):
+    router.predict(data[2], timeout=30.0)
+    # let the health loop tick: router timeline samples + worker pings
+    deadline = time.monotonic() + 15.0
+    status = router.status(timeout=10.0)
+    while time.monotonic() < deadline:
+        tl = status["timelines"]
+        if {"worker-0", "worker-1", "cluster-router"} <= set(tl) and all(
+            tl[k] for k in ("worker-0", "worker-1", "cluster-router")
+        ):
+            break
+        time.sleep(0.3)
+        status = router.status(timeout=10.0)
+    tl = status["timelines"]
+    assert {"worker-0", "worker-1", "cluster-router"} <= set(tl), tl.keys()
+    row = tl["cluster-router"][-1]
+    assert {"ts", "counters", "gauges", "latency", "queue_age"} <= set(row)
+    assert status["live_workers"] == 2
+    assert [w["index"] for w in status["workers"]] == [0, 1]
+    assert status["counters"].get("completed", 0) >= 1
+    # the text rendering never crashes and shows the timeline lines
+    from keystone_tpu.cluster import format_status
+
+    text = format_status(status)
+    assert "timeline [worker-0]" in text and "workers 2/2" in text
+
+
+def test_d_sampling_knob_bounds_span_production(router, data, tracer):
+    # rate 0.5 => exactly every 2nd submit mints a trace context
+    router._sampler = Sampler(0.5)
+    try:
+        _, cursor = tracer.spans_since(0)
+        for i in range(4):
+            router.predict(data[3 + i], timeout=30.0)
+        fresh, _ = tracer.spans_since(cursor)
+        rpc = [s for s in fresh if s.name == "rpc.request"]
+        assert len(rpc) == 2, [s.name for s in fresh]
+    finally:
+        router._sampler = Sampler(1.0)

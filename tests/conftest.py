@@ -33,14 +33,17 @@ def pipeline_env():
 
     import keystone_tpu.cost as cost
     import keystone_tpu.faults as faults
+    import keystone_tpu.obs.flight as flight
 
     env = PipelineEnv.get_or_create()
     env.reset()
     clear_memo()  # memoized plans pin operator objects; start each test cold
     cost.reset()  # profile store is env-var-memoized like the AOT cache
     faults.clear()  # no fault plan (or stale invocation counters) leaks
+    flight.reset()  # each test judges its own bounded flight window
     yield env
     env.reset()
     clear_memo()
     cost.reset()
     faults.clear()
+    flight.reset()

@@ -1,0 +1,140 @@
+"""The always-on flight recorder (keystone_tpu/obs/flight.py): bounded
+ring semantics, atomic JSON dumps, and the fault-site → recovery-instant
+contract the invariant lint enforces."""
+
+import json
+import os
+import threading
+
+from keystone_tpu.obs.flight import (
+    SITE_INSTANTS,
+    FlightRecorder,
+    dump,
+    record_instant,
+    record_span,
+    recorder,
+)
+
+
+def test_ring_is_bounded_and_ordered():
+    rec = FlightRecorder(ring=8)
+    for i in range(20):
+        rec.record_span("serve.replica", 0.001 * i, seq=i)
+    entries = rec.entries()
+    assert len(entries) == 8
+    # the ring keeps the NEWEST window, oldest first
+    assert [e["attrs"]["seq"] for e in entries] == list(range(12, 20))
+    assert all(e["kind"] == "span" for e in entries)
+
+
+def test_instants_and_spans_interleave_with_timestamps():
+    rec = FlightRecorder(ring=16)
+    rec.record_span("rpc.request", 0.5, worker=1)
+    rec.record_instant("fault.worker_down", worker=1)
+    a, b = rec.entries()
+    assert a["kind"] == "span" and a["seconds"] == 0.5
+    assert b["kind"] == "instant" and b["name"] == "fault.worker_down"
+    assert b["t"] >= a["t"] > 0
+
+
+def test_dump_is_valid_json_and_atomic(tmp_path):
+    rec = FlightRecorder(ring=64)
+    for i in range(70):
+        rec.record_span("serve.replica", 0.002, replica=i % 2)
+    rec.record_instant("fault.replica_down", replica=0)
+    path = rec.dump("replica_quarantine", path=str(tmp_path / "f.json"))
+    assert path is not None and os.path.exists(path)
+    # no torn tmp file left behind
+    assert [p for p in os.listdir(tmp_path)] == ["f.json"]
+    doc = json.loads(open(path).read())
+    assert doc["trigger"] == "replica_quarantine"
+    assert doc["pid"] == os.getpid()
+    assert doc["ring_capacity"] == 64
+    assert doc["dropped_before_window"] == 7  # 71 records into 64 slots
+    assert len(doc["entries"]) == 64
+    assert doc["entries"][-1]["name"] == "fault.replica_down"
+
+
+def test_dump_default_dir_honors_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    rec = FlightRecorder(ring=4)
+    rec.record_instant("slo.breach", objective="p99_budget_s")
+    path = rec.dump("test_trigger")
+    assert path is not None
+    assert os.path.dirname(path) == str(tmp_path)
+    assert "test_trigger" in os.path.basename(path)
+
+
+def test_dump_failure_never_raises(tmp_path):
+    rec = FlightRecorder(ring=4)
+    rec.record_instant("x")
+    missing = tmp_path / "no" / "such" / "dir" / "f.json"
+    assert rec.dump("t", path=str(missing)) is None
+
+
+def test_module_recorder_is_process_global_and_always_on():
+    # no install step: recording works immediately (the always-on
+    # contract), and the module helpers hit one shared ring
+    record_span("serve.replica", 0.001, replica=0)
+    record_instant("fault.inject", site="scan.chunk")
+    names = [e["name"] for e in recorder().entries()]
+    assert "serve.replica" in names and "fault.inject" in names
+
+
+def test_concurrent_writers_never_lose_the_bound():
+    rec = FlightRecorder(ring=32)
+
+    def hammer(k):
+        for i in range(200):
+            rec.record_span("s", 0.0, k=k, i=i)
+
+    threads = [threading.Thread(target=hammer, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(rec.entries()) == 32
+
+
+def test_site_instants_covers_every_registered_fault_site():
+    # the python-side mirror of lint rule 4: every fault site constant
+    # in faults/plan.py must map to a recovery instant
+    import keystone_tpu.faults.plan as plan
+
+    sites = {
+        v for k, v in vars(plan).items()
+        if k.isupper() and isinstance(v, str) and "." in v
+        and k not in ("MAX_BACKOFF_S",)
+    }
+    assert sites, "no fault sites found — the reflection broke"
+    assert sites <= set(SITE_INSTANTS), (
+        sites - set(SITE_INSTANTS)
+    )
+
+
+def test_fault_point_records_into_flight_ring():
+    from keystone_tpu import faults
+
+    faults.install(faults.parse_plan("scan.chunk=transient@0"))
+    try:
+        try:
+            faults.fault_point("scan.chunk")
+        except faults.FaultInjected:
+            pass
+        entries = recorder().entries()
+        hits = [
+            e for e in entries
+            if e["name"] == "fault.inject"
+            and e.get("attrs", {}).get("site") == "scan.chunk"
+        ]
+        assert hits, entries
+    finally:
+        faults.clear()
+
+
+def test_global_dump_writes_through_module_helper(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    record_instant("trainer.park", batch_start=0, batch_stop=2)
+    path = dump("trainer_park")
+    doc = json.loads(open(path).read())
+    assert any(e["name"] == "trainer.park" for e in doc["entries"])

@@ -1,0 +1,112 @@
+"""SLO policy evaluation + watchdog emission (keystone_tpu/serving/slo.py)."""
+
+from keystone_tpu.obs import flight
+from keystone_tpu.serving.metrics import MetricsRegistry
+from keystone_tpu.serving.slo import SloBreach, SloPolicy, SloWatchdog
+
+
+def _row(**over):
+    row = {
+        "ts": 100.0,
+        "counters": {},
+        "gauges": {},
+        "latency": {},
+        "queue_age": {},
+        "occupancy": None,
+    }
+    row.update(over)
+    return row
+
+
+def test_unset_objectives_are_not_evaluated():
+    assert SloPolicy().evaluate(
+        _row(latency={"p99": 99.0}, counters={"restarts": 50})
+    ) == []
+
+
+def test_p99_and_queue_age_budgets():
+    pol = SloPolicy(p99_budget_s=0.5, queue_age_p99_budget_s=0.1)
+    ok = pol.evaluate(
+        _row(latency={"p99": 0.4}, queue_age={"p99": 0.05})
+    )
+    assert ok == []
+    bad = pol.evaluate(
+        _row(latency={"p99": 0.6}, queue_age={"p99": 0.2})
+    )
+    assert [b.objective for b in bad] == [
+        "p99_budget_s", "queue_age_p99_budget_s"
+    ]
+    assert bad[0].observed == 0.6 and bad[0].budget == 0.5
+    assert bad[0].ts == 100.0
+
+
+def test_shed_rate_judged_only_with_traffic():
+    pol = SloPolicy(max_shed_rate=0.25)
+    assert pol.evaluate(_row()) == []  # quiet window: nothing to judge
+    assert pol.evaluate(
+        _row(counters={"submitted": 9, "shed": 1})
+    ) == []  # 10% refused
+    (b,) = pol.evaluate(
+        _row(counters={"submitted": 4, "shed": 3, "rejected": 3})
+    )
+    assert b.objective == "max_shed_rate" and b.observed == 0.6
+
+
+def test_restart_burn_counts_both_tiers():
+    pol = SloPolicy(max_restart_burn=1)
+    assert pol.evaluate(_row(counters={"restarts": 1})) == []
+    (b,) = pol.evaluate(
+        _row(counters={"restarts": 1, "trainer_restarts": 1})
+    )
+    assert b.objective == "max_restart_burn" and b.observed == 2
+
+
+def test_trainer_staleness_and_drift_gauges():
+    pol = SloPolicy(max_staleness_s=60.0, max_drift_score=3.0)
+    assert pol.evaluate(
+        _row(gauges={"staleness_s": 10.0, "drift_score": 1.0})
+    ) == []
+    bad = pol.evaluate(
+        _row(gauges={"staleness_s": 120.0, "drift_score": 4.5})
+    )
+    assert [b.objective for b in bad] == [
+        "max_staleness_s", "max_drift_score"
+    ]
+    # a registry with no trainer attached simply lacks the gauges
+    assert pol.evaluate(_row()) == []
+
+
+def test_watchdog_tick_samples_judges_and_emits():
+    reg = MetricsRegistry(name="fleet")
+    for _ in range(8):
+        reg.observe_latency(2.0)
+    dog = SloWatchdog(reg, SloPolicy(p99_budget_s=1.0), source="test-tier")
+    found = dog.tick()
+    assert [b.objective for b in found] == ["p99_budget_s"]
+    # emitted: counters (total + per-objective), flight instant, kept list
+    assert reg.count("slo_breaches") == 1
+    assert reg.count("slo_breach.p99_budget_s") == 1
+    assert dog.breaches == found
+    hits = [
+        e for e in flight.recorder().entries()
+        if e["name"] == "slo.breach"
+    ]
+    assert hits and hits[-1]["attrs"]["source"] == "test-tier"
+    assert hits[-1]["attrs"]["objective"] == "p99_budget_s"
+    # the breach rides the timeline row too (sample happened inside tick)
+    assert len(reg.timeline()) == 1
+
+
+def test_watchdog_quiet_tick_emits_nothing():
+    reg = MetricsRegistry(name="fleet")
+    reg.observe_latency(0.1)
+    dog = SloWatchdog(reg, SloPolicy(p99_budget_s=1.0))
+    assert dog.tick() == []
+    assert reg.count("slo_breaches") == 0
+
+
+def test_breach_is_a_typed_value():
+    b = SloBreach("p99_budget_s", 0.7, 0.5, 1.0)
+    assert b.as_attrs() == {
+        "objective": "p99_budget_s", "observed": 0.7, "budget": 0.5,
+    }

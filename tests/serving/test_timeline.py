@@ -1,0 +1,132 @@
+"""The metrics timeline (MetricsRegistry.sample_timeline) and its
+interaction with merge — the satellite contract: merged timelines stay
+per-process (never blended), sampler rows survive
+``snapshot(sketches=True)`` round-trips, and quantile reservoirs are
+deterministic under seeded fill."""
+
+import pickle
+
+import numpy as np
+
+from keystone_tpu.serving.metrics import MetricsRegistry
+
+
+def _filled(name, seed, n=64):
+    reg = MetricsRegistry(name=name)
+    rng = np.random.RandomState(seed)
+    for v in rng.rand(n):
+        reg.observe_latency(float(v))
+        reg.observe_queue_age(float(v) / 2)
+    reg.inc("submitted", n)
+    reg.inc("completed", n)
+    return reg
+
+
+def test_rows_carry_counter_deltas_not_totals():
+    reg = MetricsRegistry(name="w0")
+    reg.inc("completed", 5)
+    r1 = reg.sample_timeline(now=1.0)
+    reg.inc("completed", 3)
+    reg.inc("shed", 2)
+    r2 = reg.sample_timeline(now=2.0)
+    r3 = reg.sample_timeline(now=3.0)
+    assert r1["counters"] == {"completed": 5}
+    assert r2["counters"] == {"completed": 3, "shed": 2}
+    assert r3["counters"] == {}  # quiet window: no deltas, row still lands
+    assert [row["ts"] for row in reg.timeline()] == [1.0, 2.0, 3.0]
+
+
+def test_timeline_ring_is_bounded():
+    reg = MetricsRegistry(name="w0", timeline_window=4)
+    for i in range(10):
+        reg.sample_timeline(now=float(i))
+    assert [r["ts"] for r in reg.timeline()] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_rows_include_quantiles_gauges_and_occupancy():
+    reg = _filled("w0", seed=0)
+    reg.set_gauge("queue_depth", lambda: 7)
+    reg.observe_batch(6, 8, replica=0)
+    row = reg.sample_timeline(now=1.0)
+    assert row["gauges"] == {"queue_depth": 7.0}
+    assert row["latency"]["count"] == 64 and "p99" in row["latency"]
+    assert "p99" in row["queue_age"]
+    assert row["occupancy"] == 6 / 8
+
+
+def test_failing_gauge_never_breaks_a_sample():
+    reg = MetricsRegistry(name="w0")
+
+    def boom():
+        raise RuntimeError("gauge died")
+
+    reg.set_gauge("bad", boom)
+    reg.set_gauge("good", lambda: 1.0)
+    row = reg.sample_timeline(now=1.0)
+    assert row["gauges"] == {"good": 1.0}
+
+
+def test_rows_survive_snapshot_sketch_round_trip():
+    # the wire path: a worker snapshots (with sketches), the frame is
+    # pickled across the process boundary, the router merges — rows must
+    # arrive bit-identical
+    reg = _filled("worker-0", seed=3)
+    reg.sample_timeline(now=1.0)
+    reg.inc("completed", 2)
+    reg.sample_timeline(now=2.0)
+    snap = pickle.loads(pickle.dumps(reg.snapshot(sketches=True)))
+    assert snap["timeline"] == reg.timeline()
+    merged = MetricsRegistry.merge([snap], name="cluster")
+    assert merged["timelines"]["worker-0"] == reg.timeline()
+
+
+def test_merged_timelines_stay_per_process_never_blended():
+    a = _filled("worker-0", seed=1)
+    b = _filled("worker-1", seed=2)
+    a.sample_timeline(now=10.0)
+    b.sample_timeline(now=11.0)
+    b.sample_timeline(now=12.0)
+    merged = MetricsRegistry.merge(
+        [a.snapshot(sketches=True), b.snapshot(sketches=True)],
+        name="cluster",
+    )
+    tl = merged["timelines"]
+    assert set(tl) == {"worker-0", "worker-1"}
+    assert [r["ts"] for r in tl["worker-0"]] == [10.0]
+    assert [r["ts"] for r in tl["worker-1"]] == [11.0, 12.0]
+    # counters inside rows are each process's own deltas, untouched by
+    # the merge (the merged COUNTERS section is where summing happens)
+    assert tl["worker-0"][0]["counters"]["completed"] == 64
+    assert tl["worker-1"][0]["counters"]["completed"] == 64
+    assert merged["counters"]["completed"] == 128
+    # no blended top-level timeline is fabricated
+    assert "timeline" not in merged
+
+
+def test_quantile_reservoirs_deterministic_under_seeded_fill():
+    snaps = []
+    for _ in range(2):
+        a = _filled("worker-0", seed=7)
+        b = _filled("worker-1", seed=8)
+        merged = MetricsRegistry.merge(
+            [a.snapshot(sketches=True), b.snapshot(sketches=True)]
+        )
+        snaps.append((merged["latency"], merged["queue_age"],
+                      a.latency_quantiles(), a.queue_age_quantiles()))
+    assert snaps[0] == snaps[1]
+    merged_lat = snaps[0][0]
+    assert merged_lat["count"] == 128
+    # exact recompute from the merged raw reservoirs, not an average of
+    # per-process percentiles
+    pool = sorted(
+        list(np.random.RandomState(7).rand(64))
+        + list(np.random.RandomState(8).rand(64))
+    )
+    expected = MetricsRegistry._quantiles([float(x) for x in pool])
+    assert merged_lat == expected
+
+
+def test_snapshot_without_timeline_rows_merges_clean():
+    reg = _filled("worker-0", seed=5)  # never sampled
+    merged = MetricsRegistry.merge([reg.snapshot(sketches=True)])
+    assert merged["timelines"] == {}

@@ -249,6 +249,75 @@ def test_trylock_expression_allowed(tmp_path):
     assert vs == []
 
 
+# ---------------------------------------------------------------------------
+# rule 4: fault-site observability
+# ---------------------------------------------------------------------------
+
+
+def _fault_tree(tmp_path, *, mapping, emit, reference=True):
+    """A minimal keystone_tpu-shaped tree: one fault site, a SITE_INSTANTS
+    mapping, and (optionally) an emission + reference of the site."""
+    root = tmp_path / "keystone_tpu"
+    (root / "faults").mkdir(parents=True)
+    (root / "obs").mkdir()
+    (root / "faults" / "plan.py").write_text(
+        'SCAN_CHUNK = "scan.chunk"\n'
+    )
+    (root / "obs" / "flight.py").write_text(
+        f"SITE_INSTANTS = {mapping!r}\n"
+    )
+    body = "def f(tracer):\n    pass\n"
+    if reference:
+        body += "from .faults.plan import SCAN_CHUNK\n"
+    if emit:
+        body += (
+            "def g(tracer):\n"
+            f'    tracer.instant({emit!r}, site=1)\n'
+        )
+    (root / "uses.py").write_text(body)
+    return str(root)
+
+
+def test_fault_site_without_mapping_flagged(tmp_path):
+    root = _fault_tree(tmp_path, mapping={}, emit="retry.attempt")
+    vs = [v for v in lint_tree(root) if v.rule == "fault-instant"]
+    assert len(vs) == 1 and "no recovery instant" in vs[0].message
+    assert vs[0].path.endswith("plan.py")
+
+
+def test_mapped_but_never_emitted_instant_flagged(tmp_path):
+    root = _fault_tree(
+        tmp_path, mapping={"scan.chunk": "retry.attempt"}, emit=None
+    )
+    vs = [v for v in lint_tree(root) if v.rule == "fault-instant"]
+    assert len(vs) == 1 and "never" in vs[0].message
+    assert vs[0].path.endswith("flight.py")
+
+
+def test_unreferenced_site_flagged(tmp_path):
+    root = _fault_tree(
+        tmp_path, mapping={"scan.chunk": "retry.attempt"},
+        emit="retry.attempt", reference=False,
+    )
+    vs = [v for v in lint_tree(root) if v.rule == "fault-instant"]
+    assert len(vs) == 1 and "never referenced" in vs[0].message
+
+
+def test_mapped_emitted_and_referenced_passes(tmp_path):
+    root = _fault_tree(
+        tmp_path, mapping={"scan.chunk": "retry.attempt"},
+        emit="retry.attempt",
+    )
+    assert [v for v in lint_tree(root) if v.rule == "fault-instant"] == []
+
+
+def test_trees_without_the_contract_files_skip_rule4(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    assert [
+        v for v in lint_tree(str(tmp_path)) if v.rule == "fault-instant"
+    ] == []
+
+
 def test_violation_str_carries_location(tmp_path):
     vs = _lint_source(tmp_path, """
         try:

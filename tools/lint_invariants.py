@@ -28,6 +28,12 @@ to fix by review more than once, plus the env-knob routing rule:
    as an *expression* — polling, try-locks — is allowed; it returns a
    bool the caller must branch on.)
 
+4. **Every fault site has a post-mortem marker.** Each ``fault_point``
+   site registered in ``faults/plan.py`` must map, in
+   ``obs/flight.py::SITE_INSTANTS``, to a recovery trace-instant its
+   handling path emits somewhere in the tree — a chaos seam whose
+   failure leaves no flight-recorder/trace evidence is flagged.
+
 Run as a script (``python tools/lint_invariants.py [root]``, exits 1 on
 violations) or via :func:`lint_tree` (the tier-1 test in
 ``tests/test_lint_invariants.py`` does the latter, so CI enforces all of
@@ -267,6 +273,147 @@ def _check_acquires(tree: ast.AST, path: str, pragmas: Dict[int, Set[str]]) -> I
 
 
 # ---------------------------------------------------------------------------
+# rule 4: fault-site observability
+# ---------------------------------------------------------------------------
+#
+# Every fault site registered in faults/plan.py must have a matching
+# trace-instant emission site: obs/flight.py's SITE_INSTANTS maps each
+# site to the recovery instant its handling path emits, and that instant
+# name must actually be emitted somewhere under the tree (a first-arg
+# string literal of some `*instant(` call). Adding a chaos seam without
+# its post-mortem marker — or renaming an instant and stranding the map —
+# fails here with file:line attribution.
+
+
+def _fault_sites(plan_path: str) -> Dict[str, Tuple[str, int]]:
+    """``{site_value: (CONST_NAME, lineno)}`` from faults/plan.py:
+    module-level ``UPPER_NAME = "dotted.site"`` string constants. Only
+    DOTTED values count — site names are ``layer.point`` by the plan
+    grammar, so an unrelated module constant (``DEFAULT_KIND = "kill"``)
+    never false-positives as a chaos seam."""
+    with open(plan_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=plan_path)
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if not (isinstance(target, ast.Name) and target.id.isupper()):
+            continue
+        if (
+            isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+            and "." in node.value.value
+        ):
+            out[node.value.value] = (target.id, node.lineno)
+    return out
+
+
+def _site_instant_map(flight_path: str) -> Tuple[Dict[str, str], int]:
+    """The literal ``SITE_INSTANTS`` dict from obs/flight.py and its
+    line number (0 when absent/not a literal)."""
+    with open(flight_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=flight_path)
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if not (
+            isinstance(target, ast.Name) and target.id == "SITE_INSTANTS"
+        ):
+            continue
+        if isinstance(node.value, ast.Dict):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    out[k.value] = v.value
+            return out, node.lineno
+    return {}, 0
+
+
+def _emitted_instant_names(tree: ast.AST) -> Set[str]:
+    """First-arg string literals of every ``*instant(...)`` call —
+    ``tracer.instant``, ``flight.record_instant``, ``self._instant``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        leaf = (
+            func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else ""
+        )
+        if "instant" not in leaf.lower():
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def _check_fault_observability(root: str) -> List[Violation]:
+    plan_path = os.path.join(root, "faults", "plan.py")
+    flight_path = os.path.join(root, "obs", "flight.py")
+    if not (os.path.exists(plan_path) and os.path.exists(flight_path)):
+        return []  # not the keystone_tpu package root (unit-test trees)
+    sites = _fault_sites(plan_path)
+    site_instants, map_line = _site_instant_map(flight_path)
+    emitted: Set[str] = set()
+    referenced: Set[str] = set()  # constant NAMEs loaded outside plan.py
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue  # rule "syntax" already reports it
+            emitted |= _emitted_instant_names(tree)
+            if os.path.abspath(path) != os.path.abspath(plan_path):
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Name):
+                        referenced.add(node.id)
+                    elif isinstance(node, ast.Attribute):
+                        referenced.add(node.attr)
+                    elif isinstance(node, ast.alias):
+                        referenced.add(node.name)
+    out: List[Violation] = []
+    for value, (const_name, lineno) in sorted(sites.items()):
+        instant = site_instants.get(value)
+        if instant is None:
+            out.append(Violation(
+                plan_path, lineno, "fault-instant",
+                f"fault site {value!r} ({const_name}) has no recovery "
+                "instant declared in obs/flight.py SITE_INSTANTS — every "
+                "chaos seam must name the post-mortem marker its "
+                "handling path emits",
+            ))
+        elif instant not in emitted:
+            out.append(Violation(
+                flight_path, map_line, "fault-instant",
+                f"SITE_INSTANTS maps {value!r} to {instant!r}, but no "
+                "*instant(...) call under the tree emits that name — "
+                "the declared marker is never produced",
+            ))
+        if const_name not in referenced:
+            out.append(Violation(
+                plan_path, lineno, "fault-instant",
+                f"fault site {value!r} ({const_name}) is registered but "
+                "never referenced outside faults/plan.py — dead chaos "
+                "seams hide untested recovery paths",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -322,6 +469,7 @@ def lint_tree(root: str) -> List[Violation]:
             path = os.path.join(dirpath, fn)
             rel = os.path.relpath(path, base)
             violations.extend(lint_file(path, rel))
+    violations.extend(_check_fault_observability(root))
     violations.sort(key=lambda v: (v.path, v.line))
     return violations
 
