@@ -3968,6 +3968,283 @@ def bench_distributed_trace() -> dict:
     }
 
 
+def bench_autoscale_qos() -> dict:
+    """Autoscaling + QoS (keystone_tpu/autoscale/): an elastic
+    ClusterRouter under a bursty two-tenant ~3x overload, against the
+    static minimum fleet on the SAME offered load.
+
+    Gates:
+      * qos_priority_ok — high-priority traffic's p99 stays inside the
+        bench budget while low absorbs the shedding (shed.low strictly
+        exceeds shed.high at the same deadline slack: the front door's
+        SHED_BIAS prices low out first);
+      * goodput_elastic_gt_static_ok — the elastic fleet (min 1, max 2,
+        breach-driven) completes more admitted-in-deadline requests
+        than the static min-size fleet over the same bursty window;
+      * scale_decisions_as_rows_ok — every scale decision is visible as
+        a typed timeline row (a ``scale_ups`` counter delta) AND in the
+        autoscaler's decision list with its triggering breach;
+      * warm_scale_up_zero_compiles_ok — a scaled-up worker boots from
+        the shared AOT cache with ZERO compiles (the demo pipeline is
+        AOT-exportable; the stall pipeline's host callback is not, so
+        the goodput half uses it only for capacity realism).
+    """
+    import shutil
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from keystone_tpu.autoscale import ScalePolicy
+    from keystone_tpu.cluster import ClusterRouter
+    from keystone_tpu.serving import Shed
+    from keystone_tpu.serving.metrics import MetricsRegistry as _MR
+    from keystone_tpu.serving.slo import SloPolicy
+
+    d = 256
+    stall_s = 0.020
+    buckets = (8,)
+    deadline_s = 0.4
+    high_p99_budget_s = 0.75
+    stall_spec = (
+        "factory", "keystone_tpu.cluster.demo:build_stall_model",
+        {"d": d, "stall_s": stall_s},
+    )
+    rng = np.random.RandomState(11)
+    data = rng.randn(64, d).astype(np.float32)
+    weights = {"gold": 3.0, "bronze": 1.0}
+
+    def make_router(elastic, **kw):
+        if elastic:
+            kw["autoscale"] = ScalePolicy(
+                min_workers=1, max_workers=2, up_breaches=2,
+                breach_window_s=10.0, up_cooldown_s=2.0,
+                down_cooldown_s=3600.0,  # the bench window is all burst
+            )
+            # tight budget relative to the ~20ms stall: sustained load
+            # breaches within a few health ticks
+            kw["slo"] = SloPolicy(p99_budget_s=0.05)
+            kw["health_interval_s"] = 0.25
+        return ClusterRouter(
+            stall_spec, workers=1, replicas_per_worker=1, buckets=buckets,
+            datum_shape=(d,), max_wait_ms=2.0, max_queue=4096,
+            spawn_timeout_s=300, tenant_weights=weights, **kw,
+        )
+
+    def measure_capacity():
+        with make_router(elastic=False) as r:
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                list(pool.map(
+                    lambda i: r.predict(data[i % len(data)]), range(32)
+                ))
+            n = 128
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                list(pool.map(
+                    lambda i: r.predict(data[i % len(data)]), range(n)
+                ))
+            return n / (time.perf_counter() - t0)
+
+    capacity_rps = measure_capacity()
+
+    def bursty_load(r, duration):
+        """Open-loop two-tenant offered load: ~3x single-worker capacity
+        in 1.5s bursts with 0.5s lulls. Even requests are gold/high, odd
+        bronze/low — equal deadline slack, so shed ordering is purely
+        the priority discipline's doing. Returns (goodput, offered,
+        front-door sheds by class seen as counters on the router)."""
+        target_rate = 3.0 * capacity_rps
+        n_submitters = 4
+        burst_s, lull_s = 1.5, 0.5
+        lock = threading.Lock()
+        futures = []
+        offered = [0]
+
+        def submitter(k):
+            t0 = time.perf_counter()
+            i = 0
+            share = target_rate / n_submitters
+            while (now := time.perf_counter() - t0) < duration:
+                if now % (burst_s + lull_s) >= burst_s:
+                    time.sleep(0.01)
+                    continue
+                # pace against wall-clock: lulls build a debt the next
+                # burst repays as a catch-up spike — genuinely bursty
+                if i < now * share:
+                    pr, tn = (
+                        ("high", "gold") if i % 2 == 0
+                        else ("low", "bronze")
+                    )
+                    try:
+                        f = r.submit(
+                            data[i % len(data)], timeout=deadline_s,
+                            priority=pr, tenant=tn,
+                        )
+                        with lock:
+                            futures.append(f)
+                    except Exception:
+                        pass  # shed/queue-full: counted router-side
+                    i += 1
+                else:
+                    time.sleep(0.002)
+            with lock:
+                offered[0] += i
+
+        subs = [
+            threading.Thread(target=submitter, args=(k,))
+            for k in range(n_submitters)
+        ]
+        for t in subs:
+            t.start()
+        for t in subs:
+            t.join()
+        good = 0
+        for f in futures:
+            try:
+                f.result(timeout=120)
+                good += 1
+            except Exception:
+                pass  # shed-after-admit / expired: not goodput
+        return good, offered[0]
+
+    duration = 24.0
+
+    def run(elastic):
+        with make_router(elastic=elastic) as r:
+            for _ in range(8):  # prime worker estimates (pongs)
+                r.predict(data[0])
+            r.observe_service(buckets[0] / capacity_rps)
+            good, offered = bursty_load(r, duration)
+            snap = r.snapshot()
+            rows = r._metrics.timeline()
+            decisions = (
+                r.autoscaler.describe()["decisions"]
+                if r.autoscaler is not None else []
+            )
+            view = r.scale_view() if elastic else None
+        return {
+            "goodput": good, "offered": offered, "snap": snap,
+            "rows": rows, "decisions": decisions, "view": view,
+        }
+
+    static = run(elastic=False)
+    elastic = run(elastic=True)
+
+    c_e = elastic["snap"]["counters"]
+    prio_lat = elastic["snap"].get("priority_latency") or {}
+    high_p99 = (prio_lat.get("high") or {}).get("p99", float("inf"))
+    shed_low = c_e.get("shed.low", 0)
+    shed_high = c_e.get("shed.high", 0)
+    scale_rows = [
+        row for row in elastic["rows"]
+        if row.get("counters", {}).get("scale_ups")
+    ]
+    up_decisions = [
+        x for x in elastic["decisions"]
+        if x["action"] == "up" and x["ok"]
+    ]
+
+    # -- warm scale-up: the scaled worker boots zero-compile -------------
+    cache_dir = tempfile.mkdtemp(prefix="keystone-autoscale-aot-")
+    demo_spec = (
+        "factory", "keystone_tpu.cluster.demo:build_demo_model",
+        {"num_ffts": 1, "block_size": 512, "n_train": 512},
+    )
+    mnist_data = rng.randn(32, 784).astype(np.float32)
+    scaled_report = None
+    try:
+        # boot 1 populates the shared AOT cache (cold: compiles > 0)
+        with ClusterRouter(
+            demo_spec, workers=1, replicas_per_worker=1, buckets=(8,),
+            datum_shape=(784,), aot_cache=cache_dir, spawn_timeout_s=300,
+        ) as r:
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(
+                    lambda i: r.predict(mnist_data[i % 32]), range(16)
+                ))
+        # boot 2 is elastic: min 1, and an aggressive SLO forces the
+        # scale-up — the new slot must boot entirely from the cache
+        with ClusterRouter(
+            demo_spec, workers=1, replicas_per_worker=1, buckets=(8,),
+            datum_shape=(784,), aot_cache=cache_dir, spawn_timeout_s=300,
+            health_interval_s=0.25,
+            slo=SloPolicy(p99_budget_s=1e-4),  # any traffic breaches
+            autoscale=ScalePolicy(
+                min_workers=1, max_workers=2, up_breaches=2,
+                breach_window_s=10.0, up_cooldown_s=1.0,
+                down_cooldown_s=3600.0,
+            ),
+        ) as r:
+            deadline = time.monotonic() + 120
+            while r.live_workers < 2 and time.monotonic() < deadline:
+                r.predict(mnist_data[0])
+                time.sleep(0.05)
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(
+                    lambda i: r.predict(mnist_data[i % 32]), range(16)
+                ))
+            reports = [x for x in r.worker_reports if x]
+            scaled_up = r.live_workers
+        if len(reports) >= 2:
+            scaled_report = {
+                k: reports[1].get(k, 0) for k in ("compiles", "aot_loads")
+            }
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    return {
+        "pipeline": f"host-stall({stall_s * 1e3:.0f}ms) + tanh({d}x16 matmul)",
+        "capacity_rps_1_worker": round(capacity_rps, 1),
+        "offered": "bursty 3x capacity, 1.5s on / 0.5s off, 50/50 "
+                   "gold(high) / bronze(low), 0.4s deadlines",
+        "duration_s": duration,
+        "static_1_worker": {
+            "goodput": static["goodput"], "offered": static["offered"],
+        },
+        "elastic_1_to_2": {
+            "goodput": elastic["goodput"], "offered": elastic["offered"],
+            "scale_view": elastic["view"],
+            "decisions": elastic["decisions"],
+            "scale_timeline_rows": len(scale_rows),
+        },
+        "qos": {
+            "high_p99_s": (
+                None if high_p99 == float("inf") else round(high_p99, 4)
+            ),
+            "high_p99_budget_s": high_p99_budget_s,
+            "shed_low": shed_low,
+            "shed_high": shed_high,
+        },
+        "warm_scale_up": {
+            "scaled_worker_report": scaled_report,
+            "live_workers_after": scaled_up,
+        },
+        "qos_priority_ok": bool(
+            high_p99 <= high_p99_budget_s and shed_low > shed_high
+        ),
+        "goodput_elastic_gt_static_ok": bool(
+            elastic["goodput"] > static["goodput"]
+        ),
+        "scale_decisions_as_rows_ok": bool(
+            len(scale_rows) >= 1 and len(up_decisions) >= 1
+            and up_decisions[0].get("trigger", {}).get("objective")
+        ),
+        "warm_scale_up_zero_compiles_ok": bool(
+            scaled_up == 2
+            and scaled_report is not None
+            and scaled_report["compiles"] == 0
+            and scaled_report["aot_loads"] >= 1
+        ),
+        "knobs": (
+            "ClusterRouter(autoscale=ScalePolicy(...), tenant_weights=, "
+            "slo=SloPolicy(...)); submit(priority=, tenant=); decisions "
+            "ride the health loop off SloWatchdog breaches + timeline "
+            "rows, render under --status"
+        ),
+    }
+
+
 def _section(name, fn):
     """Run one bench section with stderr progress (stdout stays pure JSON)."""
     import sys
@@ -4012,6 +4289,7 @@ def main() -> int:
     distributed_trace = _section(
         "distributed_trace", bench_distributed_trace
     )
+    autoscale_qos = _section("autoscale_qos", bench_autoscale_qos)
     from keystone_tpu.obs import tracer as trace_mod
 
     tracer = trace_mod.current()
@@ -4061,6 +4339,7 @@ def main() -> int:
                     "fault_tolerance": fault_tolerance,
                     "continual_learning": continual_learning,
                     "distributed_trace": distributed_trace,
+                    "autoscale_qos": autoscale_qos,
                     "trace": trace_extra,
                 },
             }

@@ -20,6 +20,12 @@
 # manifest over the filesystem) and serve >= 1 micro-batch, with every
 # response matching (--expect-zero-compiles + the demo's per-worker
 # batch assertion make either failure fatal).
+# Boot 7 closes the autoscaling loop: an elastic 1..2-worker router
+# under an 8-thread burst must scale UP on SLO breaches (a new worker
+# process spawned and admitted), then — traffic stopped — drain the
+# scaled worker back DOWN after the idle cooldown, with both decisions
+# rendered in the --status view's autoscale section and zero requests
+# failed around either transition.
 # Boot 6 closes the continual-learning loop: a fleet + trainer daemon
 # (keystone_tpu/trainer/) with live traffic while chunk batches append —
 # every good batch must canary-pass and PROMOTE a refreshed model, the
@@ -95,7 +101,8 @@ print(
 PY
 echo "== boot 5 (router + 2 worker processes, warm: zero compiles in every worker) =="
 out5="$(mktemp /tmp/keystone-serve-status-XXXXXX.log)"
-"${run[@]}" --workers 2 --expect-zero-compiles --status "$@" | tee "$out5"
+"${run[@]}" --workers 2 --expect-zero-compiles --status \
+  --tenants gold:3,bronze:1 "$@" | tee "$out5"
 # --status rendered the fleet-wide timeline view (per-process rows)
 grep -q "cluster status: workers 2/2" "$out5" || {
   echo "STATUS FAIL: fleet liveness line missing from --status output"
@@ -105,6 +112,91 @@ grep -q "timeline \[worker-0\]" "$out5" || {
   echo "STATUS FAIL: no per-worker timeline in --status output"
   rm -f "$out5"; exit 1;
 }
+# the QoS view: weighted-fair tenant shares rendered from the merged
+# per-worker tenant.served.* counters
+grep -q "qos tenants: .*gold" "$out5" || {
+  echo "STATUS FAIL: no per-tenant QoS shares in --status output"
+  rm -f "$out5"; exit 1;
+}
 rm -f "$out5"
 echo "== boot 6 (continual learning: trainer daemon promotes refreshes, rolls back the poisoned batch) =="
 env JAX_PLATFORMS=cpu python -m keystone_tpu --trainer-demo --backend cpu
+echo "== boot 7 (autoscale: burst scales 1->2 on SLO breaches, idle cooldown drains back to 1) =="
+env JAX_PLATFORMS=cpu python - <<'PY'
+import threading
+import time
+
+import numpy as np
+
+from keystone_tpu.autoscale import ScalePolicy
+from keystone_tpu.cluster import ClusterRouter, format_status
+from keystone_tpu.serving.slo import SloPolicy
+
+d = 256
+spec = (
+    "factory", "keystone_tpu.cluster.demo:build_stall_model",
+    {"d": d, "stall_s": 0.020},
+)
+data = np.random.RandomState(3).randn(32, d).astype(np.float32)
+router = ClusterRouter(
+    spec, workers=1, replicas_per_worker=1, buckets=(8,),
+    datum_shape=(d,), max_wait_ms=2.0, max_queue=4096,
+    spawn_timeout_s=300, health_interval_s=0.25,
+    slo=SloPolicy(p99_budget_s=0.05),
+    autoscale=ScalePolicy(
+        min_workers=1, max_workers=2, up_breaches=2,
+        breach_window_s=5.0, up_cooldown_s=2.0, down_cooldown_s=4.0,
+        down_after_idle_ticks=4,
+    ),
+)
+with router:
+    for _ in range(8):
+        router.predict(data[0])
+    router.observe_service(8.0 / 300.0)
+    stop = [False]
+    failures = [0]
+
+    def hammer(k):
+        i = 0
+        while not stop[0]:
+            try:
+                router.predict(data[i % len(data)], timeout=2.0)
+            except Exception:
+                failures[0] += 1
+            i += 1
+
+    threads = [
+        threading.Thread(target=hammer, args=(k,)) for k in range(8)
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 60
+    while router.live_workers < 2 and time.monotonic() < deadline:
+        time.sleep(0.25)
+    scaled_up = router.live_workers == 2
+    stop[0] = True
+    for t in threads:
+        t.join()
+    assert scaled_up, "burst never scaled the fleet to 2 workers"
+    # idle now: the cooldown must drain the scaled worker back down
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        view = router.scale_view()
+        if view["admitting"] == 1 and view["draining"] == 0:
+            break
+        time.sleep(0.25)
+    snap = router.snapshot()
+    status = format_status(router.status(snap=snap))
+print(status)
+c = snap["counters"]
+assert c.get("scale_ups", 0) >= 1, f"no scale-up counted: {c}"
+assert c.get("scale_downs", 0) >= 1, f"no scale-down counted: {c}"
+assert failures[0] == 0, f"{failures[0]} requests failed around scaling"
+assert "autoscale:" in status, "status view missing the autoscale section"
+assert "SCALE up" in status and "SCALE down" in status, status
+print(
+    "AUTOSCALE STAGE OK: scaled 1->2 on breaches, drained 2->1 on idle, "
+    f"zero failed requests (scale_ups={c['scale_ups']}, "
+    f"scale_downs={c['scale_downs']})"
+)
+PY

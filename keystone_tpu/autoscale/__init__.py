@@ -1,0 +1,43 @@
+"""Autoscaling + QoS: the actuator half of the serving control loop.
+
+The observation half already exists — ``serving/slo.py`` judges the
+metrics timeline into typed breach rows, the flight ring and
+``ClusterRouter.status()`` carry them. This package ACTS on that
+evidence:
+
+* :mod:`~keystone_tpu.autoscale.qos` — the priority vocabulary
+  (``high``/``normal``/``low``: the shedding axis) and the per-tenant
+  :class:`WeightedFairQueue` (deficit round-robin: the fairness axis)
+  the fleet scheduler's queues are built from.
+* :mod:`~keystone_tpu.autoscale.policy` — :class:`ScalePolicy`, the
+  declarative bounds (min/max workers, cooldowns, breach hysteresis).
+* :mod:`~keystone_tpu.autoscale.scaler` — :class:`Autoscaler`, riding
+  the cluster router's health loop: breach rows + timeline deltas in,
+  policy-bounded spawn/drain decisions out, every decision a typed
+  timeline row + flight instant + ``scale.*`` trace span.
+"""
+
+from .policy import ScalePolicy
+from .qos import (
+    DEFAULT_PRIORITY,
+    DEFAULT_TENANT,
+    PRIORITIES,
+    PRIORITY_RANK,
+    SHED_BIAS,
+    WeightedFairQueue,
+    normalize_priority,
+)
+from .scaler import Autoscaler, ScaleDecision
+
+__all__ = [
+    "Autoscaler",
+    "DEFAULT_PRIORITY",
+    "DEFAULT_TENANT",
+    "PRIORITIES",
+    "PRIORITY_RANK",
+    "SHED_BIAS",
+    "ScaleDecision",
+    "ScalePolicy",
+    "WeightedFairQueue",
+    "normalize_priority",
+]

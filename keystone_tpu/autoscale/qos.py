@@ -1,0 +1,291 @@
+"""QoS primitives: the priority vocabulary and the weighted-fair queue.
+
+Two axes, deliberately orthogonal:
+
+* **Priority** (``high`` / ``normal`` / ``low``) is the SHEDDING axis.
+  Admission prices a request's wait against its deadline, and priority
+  decides how much of the queue ahead it must pay for: the in-process
+  scheduler counts only same-or-higher-priority depth (exact — it owns
+  the queues), the cluster front door scales its aggregate estimate by
+  :data:`SHED_BIAS` (coarse — outstanding work is already inside worker
+  processes). Both orderings are deterministic: at equal deadline slack
+  a low request always sheds before a high one, because low pays for
+  strictly more queue (or a strictly larger bias) than high does.
+* **Tenant** is the FAIRNESS axis. Each per-replica queue is a
+  :class:`WeightedFairQueue`: deficit round-robin across tenants, so
+  ``next_batch``/``_gather`` serves tenants proportionally to weight
+  instead of FIFO — one hot tenant can saturate its share, never the
+  fleet. Within a tenant, dispatch is priority-ordered (high first);
+  across tenants, priority does NOT jump the fairness schedule — that
+  is what keeps a tenant from buying the whole fleet by marking
+  everything ``high``.
+
+The queue is deque-compatible on purpose: the fleet scheduler's
+admission / gather / steal / requeue machinery drives it through the
+same ``append`` / ``appendleft`` / ``popleft`` / ``pop`` verbs it used
+on plain deques, and every request object carries its own ``priority``
+and ``tenant`` — so cloning, stealing, and requeueing preserve QoS
+identity with no extra bookkeeping.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+#: the priority vocabulary, best first (also the dispatch order within
+#: one tenant's lanes)
+PRIORITIES = ("high", "normal", "low")
+
+#: priority -> dispatch rank (0 serves first, sheds last)
+PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+DEFAULT_PRIORITY = "normal"
+DEFAULT_TENANT = "default"
+
+#: the cluster front door's admission bias: the aggregate-depth wait
+#: estimate is scaled by this per priority. The router cannot see inside
+#: its workers' queues, so the bias encodes what weighted-fair dispatch
+#: will do to each class: high is served ahead of lower classes in its
+#: tenant (it waits for less than the average), low is served last (it
+#: waits for more). Monotone in rank, which is what makes the shed
+#: ordering deterministic.
+SHED_BIAS = {"high": 0.5, "normal": 1.0, "low": 1.5}
+
+
+def normalize_priority(priority: Optional[str]) -> str:
+    """The canonical priority string (``None`` -> ``normal``); raises
+    ``ValueError`` on anything outside the vocabulary — a typo'd
+    priority must fail the submit, not silently serve as normal."""
+    if priority is None:
+        return DEFAULT_PRIORITY
+    p = str(priority).lower()
+    if p not in PRIORITY_RANK:
+        raise ValueError(
+            f"unknown priority {priority!r} (expected one of {PRIORITIES})"
+        )
+    return p
+
+
+def request_rank(req) -> int:
+    """Dispatch rank of a request-like object (duck-typed: anything with
+    an optional ``priority`` attr)."""
+    return PRIORITY_RANK.get(
+        getattr(req, "priority", DEFAULT_PRIORITY), PRIORITY_RANK["normal"]
+    )
+
+
+def request_tenant(req) -> str:
+    return getattr(req, "tenant", DEFAULT_TENANT) or DEFAULT_TENANT
+
+
+class WeightedFairQueue:
+    """Deficit-round-robin queue over per-tenant priority lanes.
+
+    Each tenant owns one deque per priority rank. ``popleft`` runs DRR:
+    the tenant at the head of the round is charged one quantum per
+    visit (its weight normalized by the largest active weight, so the
+    heaviest tenant's quantum is exactly one request); a tenant whose
+    deficit reaches 1.0 serves the head of its highest-priority
+    non-empty lane and pays 1.0, otherwise it rotates to the back and
+    keeps the deficit — over any window the served ratio converges to
+    the weight ratio, deterministically (seeded tests assert the exact
+    sequence). A tenant that empties leaves the round with its deficit
+    forfeited: fairness shares the present backlog, it does not bank
+    credit for traffic a tenant never offered.
+
+    Deque-compat: ``append``/``appendleft`` place into the request's
+    own (tenant, rank) lane; ``pop`` (the work-stealing verb) takes the
+    newest request of the LOWEST-priority populated rank from its
+    deepest tenant — the victim keeps its oldest, tightest work and its
+    best traffic class; ``__iter__`` yields everything (requeue drains
+    via ``list(q)``).
+    """
+
+    def __init__(
+        self,
+        weights: Optional[Dict[str, float]] = None,
+        default_weight: float = 1.0,
+    ):
+        self._weights = {
+            str(k): float(v) for k, v in (weights or {}).items()
+        }
+        for t, w in self._weights.items():
+            if w <= 0:
+                raise ValueError(
+                    f"tenant {t!r} weight must be > 0, got {w}"
+                )
+        self._default_weight = float(default_weight)
+        #: tenant -> one deque per priority rank
+        self._lanes: Dict[str, List[deque]] = {}
+        self._round: deque = deque()  # tenants holding DRR turns
+        self._in_round: set = set()
+        self._deficit: Dict[str, float] = {}
+        self._charged: Dict[str, bool] = {}
+        self._len = 0
+
+    # -- weights ---------------------------------------------------------
+
+    def weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, self._default_weight)
+
+    def _quantum(self, tenant: str) -> float:
+        mx = max(self.weight(t) for t in self._round)
+        return self.weight(tenant) / mx
+
+    # -- deque-compatible writes ----------------------------------------
+
+    def _enter(self, req) -> List[deque]:
+        tenant = request_tenant(req)
+        lanes = self._lanes.get(tenant)
+        if lanes is None:
+            lanes = [deque() for _ in PRIORITIES]
+            self._lanes[tenant] = lanes
+        if tenant not in self._in_round:
+            self._in_round.add(tenant)
+            self._round.append(tenant)
+            self._deficit.setdefault(tenant, 0.0)
+            self._charged.setdefault(tenant, False)
+        return lanes
+
+    def append(self, req) -> None:
+        self._enter(req)[request_rank(req)].append(req)
+        self._len += 1
+
+    def appendleft(self, req) -> None:
+        """Front-of-line within the request's own (tenant, rank) lane —
+        the requeue verb: rerouted work is the oldest in the system and
+        must not re-pay the line, but it re-pays only ITS line, never
+        another tenant's or a better class's."""
+        self._enter(req)[request_rank(req)].appendleft(req)
+        self._len += 1
+
+    def extend(self, reqs) -> None:
+        for r in reqs:
+            self.append(r)
+
+    # -- deque-compatible reads/removals --------------------------------
+
+    def _retire(self, tenant: str) -> None:
+        """Drop an emptied tenant from the round, deficit forfeited."""
+        if self._round and self._round[0] == tenant:
+            self._round.popleft()
+        else:
+            try:
+                self._round.remove(tenant)
+            except ValueError:
+                pass  # lint: allow-silent -- already out of the round
+        self._in_round.discard(tenant)
+        self._deficit[tenant] = 0.0
+        self._charged[tenant] = False
+
+    @staticmethod
+    def _pop_ranked(lanes: List[deque]):
+        for lane in lanes:
+            if lane:
+                return lane.popleft()
+        raise IndexError("pop from empty tenant lanes")
+
+    def popleft(self):
+        """DRR dispatch: the next request the fairness schedule owes."""
+        if not self._len:
+            raise IndexError("pop from an empty WeightedFairQueue")
+        spins = 0
+        while True:
+            tenant = self._round[0]
+            lanes = self._lanes[tenant]
+            if not any(lanes):
+                self._retire(tenant)
+                continue
+            if len(self._round) == 1:
+                # sole active tenant: fairness is moot, serve directly
+                # (and keep its deficit parked — no banking)
+                self._len -= 1
+                return self._pop_ranked(lanes)
+            if not self._charged[tenant]:
+                self._deficit[tenant] += self._quantum(tenant)
+                self._charged[tenant] = True
+            if self._deficit[tenant] >= 1.0 or spins > 64 * len(self._round):
+                # the spin guard bounds pathological weight ratios; DRR
+                # order is preserved for any sane (< ~1:64) spread
+                self._deficit[tenant] = max(
+                     0.0, self._deficit[tenant] - 1.0
+                )
+                self._charged[tenant] = False
+                self._round.rotate(-1)
+                self._len -= 1
+                return self._pop_ranked(lanes)
+            # insufficient deficit: keep it, yield the turn
+            self._charged[tenant] = False
+            self._round.rotate(-1)
+            spins += 1
+
+    def pop(self):
+        """The work-stealing verb: newest request of the lowest-priority
+        populated rank, from the tenant deepest in that rank — the
+        victim keeps its oldest work and its best traffic class."""
+        if not self._len:
+            raise IndexError("pop from an empty WeightedFairQueue")
+        for rank in range(len(PRIORITIES) - 1, -1, -1):
+            best = None
+            for tenant, lanes in self._lanes.items():
+                if lanes[rank] and (
+                    best is None
+                    or len(lanes[rank]) > len(self._lanes[best][rank])
+                ):
+                    best = tenant
+            if best is not None:
+                self._len -= 1
+                return self._lanes[best][rank].pop()
+        raise IndexError("pop from an empty WeightedFairQueue")  # unreachable
+
+    def clear(self) -> None:
+        self._lanes.clear()
+        self._round.clear()
+        self._in_round.clear()
+        self._deficit.clear()
+        self._charged.clear()
+        self._len = 0
+
+    def __iter__(self) -> Iterator:
+        for lanes in self._lanes.values():
+            for lane in lanes:
+                yield from lane
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __bool__(self) -> bool:
+        return self._len > 0
+
+    def __getitem__(self, index: int):
+        """Positional peek in iteration order (tenant insertion order,
+        priority-then-FIFO within each) — test/introspection seam, not a
+        hot path."""
+        n = self._len
+        if index < 0:
+            index += n
+        if not 0 <= index < n:
+            raise IndexError(index)
+        for i, req in enumerate(self):
+            if i == index:
+                return req
+        raise IndexError(index)  # unreachable: _len guards above
+
+    # -- QoS introspection ----------------------------------------------
+
+    def rank_lens(self) -> List[int]:
+        """Queued count per priority rank (index = rank) — what the
+        scheduler's priority-aware admission pricing sums."""
+        out = [0] * len(PRIORITIES)
+        for lanes in self._lanes.values():
+            for rank, lane in enumerate(lanes):
+                out[rank] += len(lane)
+        return out
+
+    def tenant_depths(self) -> Dict[str, int]:
+        return {
+            t: sum(len(lane) for lane in lanes)
+            for t, lanes in self._lanes.items()
+            if any(lanes)
+        }

@@ -42,6 +42,20 @@ over its slice of the mesh:
   force-kills a wedged worker, and answers every admitted request typed
   — mirroring the fleet's bounded thread shutdown at process scope, so
   demo and smoke runs never hang.
+* **QoS + autoscaling.** ``submit`` takes ``priority``/``tenant``
+  (:mod:`keystone_tpu.autoscale.qos`): the front-door shed estimate is
+  scaled by the priority's :data:`~keystone_tpu.autoscale.qos.SHED_BIAS`
+  (low sheds strictly before high) and both identities ride the wire to
+  the worker fleet's weighted-fair queues. With ``autoscale=ScalePolicy``
+  an :class:`~keystone_tpu.autoscale.Autoscaler` rides the health loop:
+  SLO breach rows buy worker slots (spawned through the same
+  ``_spawn_worker`` path — warm-booted zero-compile from the shared AOT
+  cache), sustained idle drains the highest slot (stop admitting, wait
+  out its in-flight work, stop, join, retire — orphans requeue with
+  deadlines intact), and every decision lands as counters, flight
+  instants, and ``scale.*`` spans. The router implements the scaler's
+  actuator verbs (``scale_view``/``scale_up_slot``/
+  ``pick_drain_candidate``/``begin_drain``/``reap_slot``).
 """
 
 from __future__ import annotations
@@ -58,6 +72,13 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..autoscale import Autoscaler, ScalePolicy
+from ..autoscale.qos import (
+    DEFAULT_TENANT,
+    PRIORITIES,
+    SHED_BIAS,
+    normalize_priority,
+)
 from ..faults import WORKER_SPAWN, fault_point
 from ..obs import flight as _flight
 from ..obs.context import Sampler, TraceContext, new_trace_id
@@ -74,6 +95,7 @@ from .wire import (
     ConnectionClosed,
     deadline_to_wire,
     decode_error,
+    qos_to_wire,
     recv_msg,
     send_msg,
 )
@@ -103,6 +125,10 @@ class _PendingReq:
     trace: Optional[TraceContext] = None
     #: perf_counter at admission — the rpc.request span's start
     t_submit_pc: float = 0.0
+    #: QoS identity (autoscale/qos.py) — preserved across requeues and
+    #: shipped on the wire so the worker fleet re-applies the same class
+    priority: str = "normal"
+    tenant: str = DEFAULT_TENANT
 
 
 class _WorkerSlot:
@@ -120,6 +146,13 @@ class _WorkerSlot:
         #: a respawn is scheduled/booting: requests may PARK awaiting it
         #: (set by the down-handler, cleared on ready or failed respawn)
         self.respawning = False
+        #: autoscale lifecycle: a spawned-but-not-ready scale-up slot
+        #: (booting), a slot no longer admitting while its outstanding
+        #: work finishes (draining), and a slot given back (retired —
+        #: terminal until the scaler re-arms it for a later scale-up)
+        self.booting = False
+        self.draining = False
+        self.retired = False
         self.outstanding: set = set()
         self.depth = 0  # worker-reported local queue depth (pongs)
         self.ready_report: Optional[dict] = None
@@ -172,6 +205,8 @@ class ClusterRouter:
         log_level: Optional[str] = None,
         slo: Optional[SloPolicy] = None,
         trace_sample: Optional[float] = None,
+        autoscale: Optional[ScalePolicy] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         self._n = workers if workers is not None else default_workers()
         if self._n < 1:
@@ -192,6 +227,9 @@ class ClusterRouter:
             "warmup": warmup,
             "virtual_devices": virtual_devices,
             "log_level": log_level,
+            "tenant_weights": (
+                dict(tenant_weights) if tenant_weights else None
+            ),
         }
         self._metrics = metrics or MetricsRegistry(name="cluster-router")
         self._max_queue = int(max_queue)
@@ -226,6 +264,12 @@ class ClusterRouter:
         self._watchdog = (
             SloWatchdog(self._metrics, slo, source="cluster-router")
             if slo is not None else None
+        )
+        #: the breach-driven scaler rides the health loop; the router is
+        #: its actuator (scale_view / scale_up_slot / begin_drain / ...)
+        self._autoscaler = (
+            Autoscaler(autoscale, self, metrics=self._metrics)
+            if autoscale is not None else None
         )
         #: the router's own spans, moved out of the process tracer into
         #: this bounded buffer at each collect_trace (mirrors the
@@ -281,9 +325,19 @@ class ClusterRouter:
 
     @property
     def capacity(self) -> int:
-        """Fleet-wide concurrent batch capacity (live workers only)."""
+        """Fleet-wide concurrent batch capacity (admitting workers only
+        — a draining slot finishes its outstanding work but takes no
+        more, so it no longer backs the shed pricing)."""
         with self._lock:
-            return sum(s.capacity for s in self._slots if s.alive)
+            return sum(
+                s.capacity for s in self._slots
+                if s.alive and not s.draining
+            )
+
+    @property
+    def autoscaler(self) -> Optional[Autoscaler]:
+        """The riding scaler, None without an ``autoscale`` policy."""
+        return self._autoscaler
 
     @property
     def live_workers(self) -> int:
@@ -405,9 +459,17 @@ class ClusterRouter:
             stdin=subprocess.PIPE,
             env=env,
         )
+        # a scaled-up slot's index can exceed the boot-time worker count;
+        # device carving (worker_device_indices) needs n_workers to cover
+        # it, so the slot ships a widened per-slot spec (co-residency on
+        # the shared mesh is placement's round-robin job)
+        spec = self._spec
+        if slot.index >= int(spec.get("n_workers") or 1):
+            spec = dict(spec)
+            spec["n_workers"] = slot.index + 1
         try:
             proc.stdin.write(
-                pickle.dumps(self._spec, protocol=pickle.HIGHEST_PROTOCOL)
+                pickle.dumps(spec, protocol=pickle.HIGHEST_PROTOCOL)
             )
             proc.stdin.close()
         except BrokenPipeError:
@@ -464,9 +526,18 @@ class ClusterRouter:
     def _register_ready(self, index: int, conn, ready: dict) -> None:
         slot = self._slots[index]
         with self._cond:
+            if slot.retired:
+                # reaped while booting (an aborted scale-up): the process
+                # was told to die; refuse the late registration
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             slot.sock = conn
             slot.alive = True
             slot.respawning = False
+            slot.booting = False
             slot.capacity = int(ready.get("capacity", 1))
             slot.ready_report = dict(ready)
             slot.outstanding = set()
@@ -571,7 +642,7 @@ class ClusterRouter:
         if ok:
             if settle_result(req.future, msg.get("value")):
                 self._metrics.inc("completed")
-                self._metrics.observe_latency(latency)
+                self._metrics.observe_latency(latency, priority=req.priority)
         else:
             exc = decode_error(msg.get("error") or {})
             # a decoded worker-side Shed is NOT counted here: the worker
@@ -601,9 +672,18 @@ class ClusterRouter:
                 if rid in self._pending
             ]
             slot.outstanding = set()
-            will_restart = (
-                not self._closed and slot.restarts < self._max_restarts
-            )
+            # a draining slot's death IS its drain finishing early; a
+            # retired slot never comes back by itself — neither respawns
+            # (the scaler owns their lifecycle, the restart budget does
+            # not)
+            if slot.draining or slot.retired:
+                slot.draining = False
+                slot.retired = True
+                will_restart = False
+            else:
+                will_restart = (
+                    not self._closed and slot.restarts < self._max_restarts
+                )
             if will_restart:
                 slot.restarts += 1
                 slot.respawning = True
@@ -672,14 +752,28 @@ class ClusterRouter:
     # -- admission -------------------------------------------------------
 
     def submit(
-        self, datum: Any, timeout: Optional[float] = None
+        self,
+        datum: Any,
+        timeout: Optional[float] = None,
+        priority: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Future:
         """Enqueue one datum; returns a Future of its prediction row.
         Raises typed: :class:`QueueFull` at capacity, :class:`Shed` when
         the learned estimate says the deadline cannot be met given the
         aggregate queue depth ÷ fleet capacity, :class:`EngineStopped`
-        after shutdown."""
+        after shutdown.
+
+        ``priority`` (``high``/``normal``/``low``) scales the shed
+        estimate by its :data:`~keystone_tpu.autoscale.qos.SHED_BIAS` —
+        the router cannot see inside worker queues, so the bias is the
+        coarse front-door form of the worker scheduler's exact per-rank
+        pricing; both orderings shed low strictly before high at equal
+        deadline slack. ``tenant`` names the weighted-fair share the
+        worker fleet serves the request from. Both ride the wire."""
         now = time.monotonic()
+        priority = normalize_priority(priority)
+        tenant = str(tenant) if tenant else DEFAULT_TENANT
         with self._lock:
             if self._closed:
                 raise EngineStopped("cluster router is shut down")
@@ -695,14 +789,19 @@ class ClusterRouter:
                     f"router queue at capacity ({self._max_queue})"
                 )
             if timeout is not None:
-                cap = sum(s.capacity for s in self._slots if s.alive)
-                est = self._service.wait(depth, cap)
+                cap = sum(
+                    s.capacity for s in self._slots
+                    if s.alive and not s.draining
+                )
+                est = self._service.wait(depth, cap) * SHED_BIAS[priority]
                 if now + est > now + timeout:
                     self._metrics.inc("shed")
+                    self._metrics.inc(f"shed.{priority}")
                     raise Shed(
                         f"deadline unmeetable at the front door: "
-                        f"estimated wait {est:.4f}s exceeds the "
-                        f"request's {timeout:.4f}s budget "
+                        f"estimated wait {est:.4f}s (at priority "
+                        f"{priority!r}) exceeds the request's "
+                        f"{timeout:.4f}s budget "
                         f"(depth {depth} / capacity {cap})"
                     )
             req = _PendingReq(
@@ -710,6 +809,8 @@ class ClusterRouter:
                 deadline=(now + timeout) if timeout is not None else None,
                 enqueued=now,
                 t_submit_pc=time.perf_counter(),
+                priority=priority,
+                tenant=tenant,
             )
             self._metrics.inc("submitted")
             # the sampling draw happens under the admission lock (the
@@ -739,10 +840,17 @@ class ClusterRouter:
                     )
                     return False
                 if from_requeue and req.deadline is not None:
-                    cap = sum(s.capacity for s in self._slots if s.alive)
-                    est = self._service.wait(len(self._pending), cap)
+                    cap = sum(
+                        s.capacity for s in self._slots
+                        if s.alive and not s.draining
+                    )
+                    est = (
+                        self._service.wait(len(self._pending), cap)
+                        * SHED_BIAS[req.priority]
+                    )
                     if time.monotonic() + est > req.deadline:
                         self._metrics.inc("shed")
+                        self._metrics.inc(f"shed.{req.priority}")
                         settle_future(
                             req.future,
                             Shed(
@@ -752,9 +860,13 @@ class ClusterRouter:
                             ),
                         )
                         return False
-                live = [s for s in self._slots if s.alive]
+                live = [
+                    s for s in self._slots if s.alive and not s.draining
+                ]
                 if not live:
-                    if any(s.respawning for s in self._slots):
+                    if any(
+                        s.respawning or s.booting for s in self._slots
+                    ):
                         self._parked.append(req)
                         return True
                     settle_future(
@@ -774,6 +886,7 @@ class ClusterRouter:
                     "id": req_id,
                     "datum": req.datum,
                     "deadline_rem": deadline_to_wire(req.deadline),
+                    **qos_to_wire(req.priority, req.tenant),
                 }
                 tracer = _trace_current() if req.trace is not None else None
                 if req.trace is not None:
@@ -838,17 +951,29 @@ class ClusterRouter:
                     self._on_worker_down(
                         slot, ConnectionClosed(f"ping failed: {e}")
                     )
+            fresh: List = []
+            row: Optional[dict] = None
             try:
                 # one timeline row per health tick; with a policy set the
                 # watchdog samples AND judges it (breaches land in the
                 # flight ring + counters), without one the row still
                 # accumulates for status()/snapshot() readers
                 if self._watchdog is not None:
-                    self._watchdog.tick()
+                    fresh = self._watchdog.tick()
+                    rows = self._metrics.timeline()
+                    row = rows[-1] if rows else None
                 else:
-                    self._metrics.sample_timeline()
+                    row = self._metrics.sample_timeline()
             except Exception:
                 logger.exception("cluster: timeline sample failed")
+            if self._autoscaler is not None:
+                try:
+                    # the closed control loop: this tick's breach rows +
+                    # timeline row become scale decisions, applied through
+                    # the actuator verbs below
+                    self._autoscaler.tick(fresh, row=row)
+                except Exception:
+                    logger.exception("cluster: autoscaler tick failed")
             now = time.monotonic()
             if now - last_log >= self._log_interval_s:
                 last_log = now
@@ -905,6 +1030,200 @@ class ClusterRouter:
                     "cluster: re-spawn of worker %d failed", s.index
                 )
 
+    # -- autoscale actuator (driven by Autoscaler.tick) ------------------
+
+    def scale_view(self) -> Dict[str, int]:
+        """The slot census the scaler budgets against: ``admitting``
+        (alive, taking traffic), ``booting`` (spawned or respawning, not
+        ready yet — already-committed capacity, so the scaler must not
+        buy it twice), ``draining`` (finishing, no longer admitting)."""
+        with self._lock:
+            admitting = booting = draining = 0
+            for s in self._slots:
+                if s.retired:
+                    continue
+                if s.alive:
+                    if s.draining:
+                        draining += 1
+                    else:
+                        admitting += 1
+                elif s.booting or s.respawning:
+                    booting += 1
+        return {
+            "admitting": admitting,
+            "booting": booting,
+            "draining": draining,
+        }
+
+    def scale_up_slot(self) -> int:
+        """Add one worker slot and spawn its process through the same
+        ``_spawn_worker`` path boot uses — against a warm shared AOT
+        cache the new worker pre-warms every manifest signature and
+        boots with ZERO compiles. Returns the slot index; the slot takes
+        no traffic until its ``ready`` registers (``_register_ready``),
+        so a death mid-boot can never fail an admitted request.
+        Retired slots are re-armed before the list grows (indices must
+        stay stable — ``_register_ready`` addresses ``_slots[index]``)."""
+        with self._lock:
+            if self._closed:
+                raise EngineStopped("router is shut down")
+            slot = next(
+                (
+                    s for s in reversed(self._slots)
+                    if s.retired and (
+                        s.proc is None or s.proc.poll() is not None
+                    )
+                ),
+                None,
+            )
+            if slot is not None:
+                slot.retired = False
+                slot.draining = False
+                slot.respawning = False
+                slot.restarts = 0
+                slot.ready_report = None
+            else:
+                slot = _WorkerSlot(len(self._slots))
+                self._slots.append(slot)
+            slot.booting = True
+        try:
+            self._spawn_worker(slot)
+        except BaseException:
+            with self._lock:
+                slot.booting = False
+                slot.retired = True
+            raise
+        return slot.index
+
+    def pick_drain_candidate(self) -> Optional[int]:
+        """The slot a scale-down should release: the HIGHEST-index
+        admitting worker (LIFO — scale-ups appended it last, and the
+        boot-time slots keep the stable low indices), or None when no
+        slot can drain."""
+        with self._lock:
+            for s in reversed(self._slots):
+                if s.alive and not s.draining and not s.retired:
+                    return s.index
+        return None
+
+    def begin_drain(self, index: int) -> None:
+        """Stop admitting to slot ``index`` and retire it off-thread:
+        wait (bounded) for its outstanding requests to finish, send the
+        worker a draining stop, join the process, release the slot. A
+        drain that times out terminates the process — the down-handler
+        then requeues whatever was left with deadlines intact, so the
+        slow path strands nothing either."""
+        with self._lock:
+            slot = self._slots[index]
+            if not slot.alive or slot.draining or slot.retired:
+                raise RuntimeError(
+                    f"worker {index} cannot drain (alive={slot.alive}, "
+                    f"draining={slot.draining}, retired={slot.retired})"
+                )
+            slot.draining = True
+            self._cond.notify_all()
+        threading.Thread(
+            target=self._drain_worker, args=(slot,),
+            name=f"ks-router-drain-{index}", daemon=True,
+        ).start()
+
+    def _drain_worker(self, slot: _WorkerSlot) -> None:
+        import subprocess
+
+        deadline = time.monotonic() + self._drain_timeout_s
+        with self._cond:
+            while (
+                slot.outstanding and slot.alive and not self._closed
+                and time.monotonic() < deadline
+            ):
+                self._cond.wait(timeout=0.2)
+            timed_out = bool(slot.outstanding) and slot.alive
+        if slot.alive and slot.sock is not None:
+            try:
+                with slot.send_lock:
+                    send_msg(slot.sock, {"type": "stop", "drain": True})
+            except Exception:
+                logger.debug(
+                    "drain stop to worker %d failed (already dead?)",
+                    slot.index, exc_info=True,
+                )
+        proc = slot.proc
+        if proc is not None:
+            try:
+                proc.wait(timeout=self._join_timeout_s)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    "cluster: draining worker %d did not exit within "
+                    "%.1fs — terminating it (its in-flight work "
+                    "requeues)", slot.index, self._join_timeout_s,
+                )
+                proc.terminate()
+                try:
+                    proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        # the socket death has (or will have) run the down-handler for
+        # any stranded work; all that is left is releasing the slot
+        with self._cond:
+            slot.alive = False
+            slot.draining = False
+            slot.retired = True
+            try:
+                if slot.sock is not None:
+                    slot.sock.close()
+            except OSError:
+                pass
+            slot.sock = None
+            self._cond.notify_all()
+        _flight.record_instant(
+            "scale.drained", worker=slot.index, timed_out=timed_out,
+        )
+        logger.info(
+            "cluster: worker %d drained and released%s", slot.index,
+            " (drain timed out; process terminated)" if timed_out else "",
+        )
+
+    def reap_slot(self, index: int) -> None:
+        """Force-retire slot ``index`` — the scaler's abort path for a
+        half-born (killed mid-scale-up) or half-drained slot. Kills the
+        process if still up and requeues anything outstanding; the slot
+        stays retired until a later scale-up re-arms it."""
+        import subprocess
+
+        with self._lock:
+            slot = self._slots[index]
+            slot.booting = False
+            slot.respawning = False
+            slot.draining = False
+            slot.retired = True
+            slot.alive = False
+            sock, slot.sock = slot.sock, None
+            proc = slot.proc
+            orphans = [
+                self._pending.pop(rid)
+                for rid in sorted(slot.outstanding)
+                if rid in self._pending
+            ]
+            slot.outstanding = set()
+            self._cond.notify_all()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        moved = 0
+        for req in orphans:
+            if not req.future.done() and self._route(req, from_requeue=True):
+                moved += 1
+        if moved:
+            self._metrics.inc("requeues", moved)
+
     def _log_merged(self) -> None:
         snap = self.snapshot(timeout=1.0)
         c = snap.get("counters", {})
@@ -914,7 +1233,7 @@ class ClusterRouter:
         logger.info(
             "cluster-router: workers=%d/%d outstanding=%d counters=%s "
             "occupancy=%s shed=%s p99=%s queue_age_p99=%s slo_breaches=%s",
-            sum(1 for s in self._slots if s.alive), self._n,
+            sum(1 for s in self._slots if s.alive), len(self._slots),
             self.outstanding, c,
             None if occ is None else round(occ, 3),
             c.get("shed", 0),
@@ -1050,6 +1369,32 @@ class ClusterRouter:
 
         return write_stitched_trace(self.collect_trace(timeout=timeout), path)
 
+    @staticmethod
+    def _qos_view(snap: dict) -> dict:
+        """The QoS digest off a merged snapshot: per-tenant served
+        counts (and their share of total service — the weighted-fair
+        convergence evidence, summed across worker processes), sheds by
+        priority class (all tiers), and per-priority latency
+        quantiles."""
+        c = snap.get("counters") or {}
+        served = {
+            k[len("tenant.served."):]: int(v)
+            for k, v in c.items()
+            if k.startswith("tenant.served.")
+        }
+        total = sum(served.values())
+        return {
+            "tenant_served": served,
+            "tenant_share": (
+                {t: round(n / total, 4) for t, n in sorted(served.items())}
+                if total else {}
+            ),
+            "shed_by_priority": {
+                p: int(c.get(f"shed.{p}", 0)) for p in PRIORITIES
+            },
+            "priority_latency": snap.get("priority_latency") or {},
+        }
+
     def status(self, timeout: float = 2.0, snap: Optional[dict] = None) -> dict:
         """The fleet-wide timeline view: liveness + capacity, the merged
         counters/quantiles, each tier's bounded metrics timeline (kept
@@ -1070,6 +1415,9 @@ class ClusterRouter:
                     "restarts": s.restarts,
                     "outstanding": len(s.outstanding),
                     "respawning": s.respawning,
+                    "booting": s.booting,
+                    "draining": s.draining,
+                    "retired": s.retired,
                 }
                 for s in self._slots
             ]
@@ -1093,6 +1441,14 @@ class ClusterRouter:
             "batch_occupancy": snap.get("batch_occupancy"),
             "timelines": timelines,
             "slo": None,
+            "qos": self._qos_view(snap),
+            "autoscale": (
+                dict(
+                    self._autoscaler.describe(),
+                    view=self.scale_view(),
+                )
+                if self._autoscaler is not None else None
+            ),
         }
         if self._watchdog is not None:
             from dataclasses import asdict
@@ -1255,7 +1611,10 @@ def format_status(status: dict) -> str:
             "  worker {index}: {state} pid={pid} capacity={capacity} "
             "restarts={restarts} outstanding={outstanding}".format(
                 state=(
-                    "respawning" if w.get("respawning")
+                    "draining" if w.get("draining")
+                    else "retired" if w.get("retired")
+                    else "booting" if w.get("booting")
+                    else "respawning" if w.get("respawning")
                     else "up" if w.get("alive") else "DOWN"
                 ),
                 **{k: w.get(k) for k in (
@@ -1274,6 +1633,60 @@ def format_status(status: dict) -> str:
             round(lat["p99"], 4) if "p99" in lat else None,
         )
     )
+    qos = status.get("qos") or {}
+    served = qos.get("tenant_served") or {}
+    sheds = qos.get("shed_by_priority") or {}
+    if served:
+        shares = qos.get("tenant_share") or {}
+        lines.append(
+            "  qos tenants: " + ", ".join(
+                "{}: served={} share={}".format(t, n, shares.get(t))
+                for t, n in sorted(served.items())
+            )
+        )
+    if any(sheds.values()):
+        lines.append(
+            "  qos shed by priority: " + " ".join(
+                f"{p}={sheds.get(p, 0)}" for p in ("high", "normal", "low")
+            )
+        )
+    plat = qos.get("priority_latency") or {}
+    if plat:
+        lines.append(
+            "  qos p99 by priority: " + " ".join(
+                "{}={}".format(
+                    p, round(q["p99"], 4) if "p99" in q else None
+                )
+                for p, q in sorted(plat.items())
+            )
+        )
+    asc = status.get("autoscale")
+    if asc:
+        view = asc.get("view") or {}
+        lines.append(
+            "  autoscale: target={} admitting={} booting={} draining={} "
+            "policy={}".format(
+                asc.get("target"), view.get("admitting"),
+                view.get("booting"), view.get("draining"),
+                asc.get("policy"),
+            )
+        )
+        for d in (asc.get("decisions") or [])[-8:]:
+            lines.append(
+                "    SCALE {action} {from_workers}->{to_workers} "
+                "[{verdict}] worker={worker} reason={reason}{trig}".format(
+                    action=d.get("action"),
+                    from_workers=d.get("from_workers"),
+                    to_workers=d.get("to_workers"),
+                    verdict="ok" if d.get("ok") else "ABORTED",
+                    worker=d.get("worker"),
+                    reason=d.get("reason"),
+                    trig=(
+                        f" trigger={d.get('trigger')}"
+                        if d.get("trigger") else ""
+                    ),
+                )
+            )
     slo = status.get("slo")
     if slo:
         lines.append(f"  slo policy: {slo.get('policy')}")

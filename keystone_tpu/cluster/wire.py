@@ -34,7 +34,15 @@ pickle efficiently enough for a localhost hop (protocol 5).
 **Trace propagation.** A sampled request's ``req`` frame additionally
 carries ``"trace"`` — the :class:`~keystone_tpu.obs.context.TraceContext`
 wire form (trace id, emitting hop, a ``time.time()`` send stamp) — and
-every ``res`` frame carries ``"t_unix"``. Monotonic clocks are
+every ``res`` frame carries ``"t_unix"``.
+
+**QoS identity.** A ``req`` frame also carries ``"priority"`` and
+``"tenant"`` (see :mod:`keystone_tpu.autoscale.qos`): the worker's
+in-process fleet re-applies the same shedding class and weighted-fair
+share the router admitted under, so crossing the process boundary never
+launders a request into a better class. :func:`qos_to_wire` /
+:func:`qos_from_wire` are the two ends; absent keys degrade to the
+defaults (normal priority, the default tenant) so old frames decode. Monotonic clocks are
 process-local, so cross-process latency attribution rides the HOST-shared
 unix clock: the receiver prices each direction's transport as
 ``time.time() - stamp`` and records it on its hop span, which is how the
@@ -186,6 +194,30 @@ def deadline_from_wire(remaining: Optional[float]) -> Optional[float]:
     if remaining is None:
         return None
     return time.monotonic() + float(remaining)
+
+
+# -- QoS identity across the boundary ----------------------------------------
+
+
+def qos_to_wire(priority: Optional[str], tenant: Optional[str]) -> dict:
+    """The ``req``-frame keys carrying a request's QoS identity; only
+    non-default values are shipped (most traffic is default-class, and
+    the frame stays minimal)."""
+    out = {}
+    if priority and priority != "normal":
+        out["priority"] = str(priority)
+    if tenant and tenant != "default":
+        out["tenant"] = str(tenant)
+    return out
+
+
+def qos_from_wire(msg: dict) -> "tuple[str, str]":
+    """``(priority, tenant)`` off a ``req`` frame, defaulting absent
+    keys — frames from a pre-QoS peer decode as normal/default."""
+    return (
+        str(msg.get("priority") or "normal"),
+        str(msg.get("tenant") or "default"),
+    )
 
 
 # -- typed errors across the boundary ----------------------------------------

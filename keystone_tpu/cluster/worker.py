@@ -113,6 +113,7 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
         ConnectionClosed,
         deadline_from_wire,
         encode_error,
+        qos_from_wire,
         recv_msg,
         send_msg,
     )
@@ -163,6 +164,7 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
         dtype=spec.get("dtype"),
         max_queue=int(spec.get("max_queue", 1024)),
         max_wait_ms=float(spec.get("max_wait_ms", 2.0)),
+        tenant_weights=spec.get("tenant_weights"),
     )
     fleet.start(warmup=spec.get("warmup"))
     snap = fleet.metrics.snapshot()
@@ -272,8 +274,10 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
                         None if deadline is None
                         else max(0.0, deadline - _time.monotonic())
                     )
+                    priority, tenant = qos_from_wire(msg)
                     fut = fleet.submit(
-                        msg["datum"], timeout=timeout, trace=ctx
+                        msg["datum"], timeout=timeout, trace=ctx,
+                        priority=priority, tenant=tenant,
                     )
                 except BaseException as e:  # Shed/QueueFull/... typed back
                     reply({
@@ -329,6 +333,7 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
                     "worker": worker_id,
                     "seq": msg.get("seq"),
                     "snapshot": fleet.metrics.snapshot(sketches=True),
+                    "qos": fleet.qos_snapshot(),
                     "spans": shipped,
                     "spans_dropped": spans_dropped,
                 })

@@ -23,6 +23,8 @@ from .checkpoint import FitCheckpoint
 from .plan import (
     AOT_READ,
     REPLICA_BATCH,
+    SCALE_DRAIN,
+    SCALE_SPAWN,
     SCAN_CHUNK,
     SCAN_STAGE,
     TRAINER_ABSORB,
@@ -49,6 +51,8 @@ __all__ = [
     "AOT_READ",
     "WORKER_SPAWN",
     "REPLICA_BATCH",
+    "SCALE_DRAIN",
+    "SCALE_SPAWN",
     "SCAN_CHUNK",
     "SCAN_STAGE",
     "TRAINER_ABSORB",

@@ -86,6 +86,15 @@ TRAINER_ABSORB = "trainer.absorb"
 #: (transient => counted as canary evidence failure: rollback + bounded
 #: batch retry, old model keeps serving)
 TRAINER_CANARY = "trainer.canary"
+#: one autoscaler scale-up apply, AFTER the new slot is spawned and
+#: BEFORE it reports ready — a kill here is a worker dying mid-scale-up:
+#: the scaler reaps the half-born slot (``scale.abort`` instant) and the
+#: next post-cooldown tick converges the fleet back to policy bounds
+SCALE_SPAWN = "scale.spawn"
+#: one autoscaler scale-down apply, after the drain begins — a kill here
+#: is a worker dying mid-drain: the scaler force-retires it and the
+#: router's down-handler requeues its in-flight work, deadlines intact
+SCALE_DRAIN = "scale.drain"
 
 _KINDS = ("transient", "fatal", "kill")
 

@@ -62,6 +62,10 @@ SITE_INSTANTS = {
     "trainer.ingest": "trainer.ingest_fault",
     "trainer.absorb": "trainer.park",
     "trainer.canary": "trainer.rollback",
+    # both scale seams recover the same way: the autoscaler reaps the
+    # half-born (or half-drained) slot and records the abort
+    "scale.spawn": "scale.abort",
+    "scale.drain": "scale.abort",
 }
 
 #: ring capacity default; KEYSTONE_FLIGHT_RING overrides at first use

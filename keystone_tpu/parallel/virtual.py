@@ -41,9 +41,31 @@ def provision_virtual_devices(n_devices: int) -> None:
     # paths never pass through here. An explicit user-set value wins.
     if "xla_cpu_use_thunk_runtime" not in flags:
         flags = f"{flags} --xla_cpu_use_thunk_runtime=false"
+    # Parallel LLVM codegen (default split 32) segfaults this jaxlib on
+    # hosts with a single schedulable core — reproducibly, deep in a
+    # sharded weighted-solver lowering mid-suite, and on the untouched
+    # seed too; any perturbation of the run (buffering, filters) moves
+    # or hides it, the signature of a native race. Single-threaded
+    # codegen trades a few seconds of compile time for a crash-free
+    # suite; an explicit user-set value wins.
+    if "xla_cpu_parallel_codegen_split_count" not in flags:
+        flags = f"{flags} --xla_cpu_parallel_codegen_split_count=1"
     os.environ["XLA_FLAGS"] = (
         flags + f" --{_COUNT_FLAG}={n_devices}"
     ).strip()
+    # The PJRT CPU client sizes its execution pool from host parallelism
+    # (PJRT_NPROC overrides it). A cross-module collective needs every
+    # partition RUNNING concurrently to reach the rendezvous; on a host
+    # with fewer cores than virtual devices the queued partitions sit
+    # behind pool-mates already blocked in the rendezvous and the
+    # dispatch deadlocks at 0% CPU (seen: 7/8 AllReduce participants
+    # arrive, the 8th never scheduled — a 1-core box hangs the BCD
+    # sweep). Guarantee one runnable thread per partition plus headroom
+    # for continuation work. An explicit user-set value wins.
+    if "PJRT_NPROC" not in os.environ:
+        os.environ["PJRT_NPROC"] = str(
+            max(2 * n_devices, os.cpu_count() or 1)
+        )
 
     import jax
 

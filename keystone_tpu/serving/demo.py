@@ -67,6 +67,16 @@ def _serve_through_cluster(args, fitted, data, buckets) -> int:
     from .. import compile as compile_mod
     from ..cluster import ClusterRouter
 
+    # --tenants "gold:3,bronze:1": weighted-fair shares in the worker
+    # fleets, traffic round-robined across the named tenants so the
+    # --status QoS section has shares to render
+    tenant_weights = None
+    if args.tenants:
+        tenant_weights = {}
+        for part in args.tenants.split(","):
+            name, _, w = part.partition(":")
+            tenant_weights[name.strip()] = float(w) if w else 1.0
+    tenant_names = list(tenant_weights) if tenant_weights else None
     cache = compile_mod.get_cache()
     router = ClusterRouter(
         ("factory", "keystone_tpu.cluster.demo:build_demo_model", {
@@ -80,13 +90,20 @@ def _serve_through_cluster(args, fitted, data, buckets) -> int:
         max_queue=args.maxQueue,
         max_wait_ms=args.maxWaitMs,
         aot_cache=cache.root if cache is not None else None,
+        tenant_weights=tenant_weights,
     )
     router.install_signal_handlers()
+
+    def _one(i_row):
+        i, row = i_row
+        tenant = (
+            tenant_names[i % len(tenant_names)] if tenant_names else None
+        )
+        return router.submit(row, timeout=120.0, tenant=tenant).result()
+
     with router:
         with ThreadPoolExecutor(max_workers=args.clients) as pool:
-            preds = list(pool.map(
-                lambda row: router.predict(row, timeout=120.0), data
-            ))
+            preds = list(pool.map(_one, enumerate(data)))
         snap = router.snapshot()
         reports = [r for r in router.worker_reports if r]
         if args.status:
@@ -172,6 +189,12 @@ def main(argv: Optional[List[str]] = None) -> int:
              "view (ClusterRouter.status() rendered — per-process "
              "metrics timelines, worker liveness, SLO verdicts) after "
              "the traffic drains",
+    )
+    p.add_argument(
+        "--tenants", default=None,
+        help="with --workers N: 'name:weight,...' — weighted-fair tenant "
+             "shares in the worker fleets; demo traffic round-robins the "
+             "names, and --status renders per-tenant served shares",
     )
     p.add_argument(
         "--expect-zero-compiles", action="store_true",

@@ -52,8 +52,9 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
+from ..autoscale.qos import DEFAULT_TENANT, normalize_priority
 from ..faults import ReplicaKilled
 from ..obs import flight as _flight
 from ..obs.tracer import current as _trace_current
@@ -135,6 +136,7 @@ class ServingFleet:
         quarantine_after: int = 3,
         join_timeout_s: float = _JOIN_TIMEOUT_S,
         drain_timeout_s: float = _DRAIN_TIMEOUT_S,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         from ..parallel.placement import replica_devices
 
@@ -195,6 +197,7 @@ class ServingFleet:
             max_queue=max_queue,
             max_wait_ms=max_wait_ms,
             steal=steal,
+            tenant_weights=tenant_weights,
         )
         self._lifecycle_lock = threading.RLock()
         # serializes whole swaps (incl. the canary window, which runs
@@ -225,6 +228,11 @@ class ServingFleet:
     @property
     def policy(self) -> BucketPolicy:
         return self._policy
+
+    def qos_snapshot(self) -> Dict[str, object]:
+        """Per-tenant queued depth/weight + queued-by-priority (see
+        :meth:`FleetScheduler.qos_snapshot`)."""
+        return self._scheduler.qos_snapshot()
 
     @property
     def scheduler(self) -> FleetScheduler:
@@ -597,6 +605,8 @@ class ServingFleet:
         datum: Any,
         timeout: Optional[float] = None,
         trace: Any = None,
+        priority: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Future:
         """Enqueue one datum; returns a Future of its prediction row.
 
@@ -607,13 +617,18 @@ class ServingFleet:
         :class:`~keystone_tpu.obs.context.TraceContext` — a sampled
         request's cross-process identity, carried so the replica's
         queue-wait and batch spans record under it (the cluster worker
-        passes the context it received off the wire)."""
+        passes the context it received off the wire). ``priority``
+        (``high``/``normal``/``low``, default normal) sets the shedding
+        class; ``tenant`` names the weighted-fair share the request is
+        served from (see :mod:`keystone_tpu.autoscale.qos`)."""
         now = time.monotonic()
         req = _Request(
             datum=datum,
             deadline=(now + timeout) if timeout is not None else None,
             enqueued=now,
             trace=trace,
+            priority=normalize_priority(priority),
+            tenant=str(tenant) if tenant else DEFAULT_TENANT,
         )
         self._scheduler.admit(req)  # counts "submitted" atomically
         return req.future

@@ -61,6 +61,10 @@ class MetricsRegistry:
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._latencies: deque = deque(maxlen=latency_window)
         self._queue_ages: deque = deque(maxlen=latency_window)
+        # priority class -> bounded reservoir: the per-class latency the
+        # QoS gates assert (high's p99 in budget while low absorbs shed)
+        self._priority_latencies: Dict[str, deque] = {}
+        self._latency_window = latency_window
         self._batch_items = 0
         self._batch_capacity = 0
         # replica index -> [items, capacity, batches]: per-replica
@@ -84,9 +88,21 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = read
 
-    def observe_latency(self, seconds: float) -> None:
+    def observe_latency(
+        self, seconds: float, priority: Optional[str] = None
+    ) -> None:
+        """One end-to-end request latency; ``priority`` additionally
+        files it under that QoS class's own reservoir so per-priority
+        quantiles survive (aggregate p99 hides a starved class)."""
         with self._lock:
             self._latencies.append(seconds)
+            if priority is not None:
+                res = self._priority_latencies.get(priority)
+                if res is None:
+                    res = self._priority_latencies[priority] = deque(
+                        maxlen=self._latency_window
+                    )
+                res.append(seconds)
 
     def observe_queue_age(self, seconds: float) -> None:
         """Time one request spent queued before its batch dispatched —
@@ -131,6 +147,15 @@ class MetricsRegistry:
         with self._lock:
             ages = sorted(self._queue_ages)
         return self._quantiles(ages)
+
+    def priority_latency_quantiles(self) -> Dict[str, Dict[str, float]]:
+        """Per-priority-class latency quantiles, one row per class that
+        has observed traffic (same schema per row as ``latency``)."""
+        with self._lock:
+            per = {
+                p: sorted(res) for p, res in self._priority_latencies.items()
+            }
+        return {p: self._quantiles(vals) for p, vals in sorted(per.items())}
 
     @staticmethod
     def _quantiles(vals: list) -> Dict[str, float]:
@@ -217,6 +242,10 @@ class MetricsRegistry:
                 {
                     "latencies": [float(x) for x in self._latencies],
                     "queue_ages": [float(x) for x in self._queue_ages],
+                    "priority_latencies": {
+                        p: [float(x) for x in res]
+                        for p, res in self._priority_latencies.items()
+                    },
                 }
                 if sketches
                 else None
@@ -241,6 +270,7 @@ class MetricsRegistry:
             },
             "latency": self.latency_quantiles(),
             "queue_age": self.queue_age_quantiles(),
+            "priority_latency": self.priority_latency_quantiles(),
             "phases": timing.snapshot(prefix="serve."),
             "spans": self._span_summary(),
             # the bounded timeline rides every snapshot (cheap: <=
@@ -276,6 +306,7 @@ class MetricsRegistry:
         replicas: Dict[str, object] = {}
         lats: list = []
         ages: list = []
+        prio_lats: Dict[str, list] = defaultdict(list)
         phases: Dict[str, Dict[str, float]] = {}
         spans: Dict[str, Dict[str, float]] = {}
         timelines: Dict[str, list] = {}
@@ -306,6 +337,8 @@ class MetricsRegistry:
             sketch = snap.get("sketch") or {}
             lats.extend(sketch.get("latencies") or [])
             ages.extend(sketch.get("queue_ages") or [])
+            for p, vals in (sketch.get("priority_latencies") or {}).items():
+                prio_lats[p].extend(vals)
             _fold_table(phases, snap.get("phases"))
             _fold_table(spans, snap.get("spans"))
             # timelines stay PER-PROCESS, never blended: each row is one
@@ -328,6 +361,10 @@ class MetricsRegistry:
             "replicas": replicas,
             "latency": MetricsRegistry._quantiles(sorted(lats)),
             "queue_age": MetricsRegistry._quantiles(sorted(ages)),
+            "priority_latency": {
+                p: MetricsRegistry._quantiles(sorted(vals))
+                for p, vals in sorted(prio_lats.items())
+            },
             "phases": {k: dict(v) for k, v in phases.items()},
             "spans": {k: dict(v) for k, v in spans.items()},
             "timelines": timelines,

@@ -113,6 +113,13 @@ class _Request:
     #: request: the replica records its queue-wait and batch spans under
     #: this identity so one request's hops stitch across the tier
     trace: Any = None
+    #: QoS identity (autoscale/qos.py): ``priority`` is the shedding
+    #: axis (low sheds before high), ``tenant`` the fairness axis (the
+    #: weighted-fair queues serve tenants proportionally to weight).
+    #: Carried ON the request so requeue clones, steals, and wire hops
+    #: preserve both with no side-channel bookkeeping.
+    priority: str = "normal"
+    tenant: str = "default"
 
 
 # ---------------------------------------------------------------------------
@@ -575,7 +582,9 @@ class Replica:
                 # result loses the set-once race, and the REST of the
                 # batch must still distribute
                 continue
-            self._metrics.observe_latency(done - r.enqueued)
+            self._metrics.observe_latency(
+                done - r.enqueued, priority=r.priority
+            )
         self._metrics.inc("completed", len(valid))
         self._metrics.observe_batch(len(valid), bucket, replica=self.index)
 

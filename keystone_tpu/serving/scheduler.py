@@ -37,6 +37,18 @@ do not need finer granularity; the hold times are microseconds):
   its oldest (tightest-deadline) work, the thief takes the back of the
   line — so one stalled replica's bucket mix cannot idle the rest of the
   fleet.
+
+QoS (``keystone_tpu/autoscale/qos.py``) rides all three: each request
+carries a ``priority`` and a ``tenant``. Admission prices a request's
+wait against only the queue depth at its priority OR BETTER — exact
+here, because the scheduler owns its queues — so at equal deadline
+slack low sheds strictly before high, and a cold scheduler still never
+sheds. The per-replica queues are :class:`WeightedFairQueue` s: deficit
+round-robin serves tenants proportionally to weight instead of FIFO
+(the batch-service EWMA prices each turn's worth identically across
+tenants, so share-of-requests IS share-of-service), and requeue/steal/
+hop machinery preserves both identities because they live on the
+request itself.
 """
 
 from __future__ import annotations
@@ -44,9 +56,15 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import deque
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from ..autoscale.qos import (
+    PRIORITIES,
+    PRIORITY_RANK,
+    WeightedFairQueue,
+    request_rank,
+    request_tenant,
+)
 from ..obs.tracer import current as _trace_current
 from .batching import BucketPolicy
 from .errors import EngineStopped, QueueFull, Shed
@@ -136,6 +154,7 @@ class FleetScheduler:
         max_queue: int = 1024,
         max_wait_ms: float = 2.0,
         steal: bool = True,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         if n_replicas < 1:
             raise ValueError(f"need at least one replica, got {n_replicas}")
@@ -149,7 +168,15 @@ class FleetScheduler:
         self._steal = steal
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queues: List[deque] = [deque() for _ in range(n_replicas)]
+        #: configured tenant -> weight (unlisted tenants weigh 1.0)
+        self._tenant_weights = dict(tenant_weights or {})
+        #: per-replica run queues: weighted-fair across tenants (DRR),
+        #: priority-ordered within one tenant — deque-compatible, so the
+        #: steal/requeue machinery below drives them unchanged
+        self._queues: List[WeightedFairQueue] = [
+            WeightedFairQueue(self._tenant_weights)
+            for _ in range(n_replicas)
+        ]
         #: replica liveness, maintained by the fleet's supervisor: a dead
         #: (restart-budget-exhausted) replica stops receiving admissions
         self._active: List[bool] = [True] * n_replicas
@@ -175,6 +202,30 @@ class FleetScheduler:
         with self._lock:
             return [len(q) for q in self._queues]
 
+    def qos_snapshot(self) -> Dict[str, object]:
+        """Point-in-time QoS view of the queues: per-tenant queued depth
+        and configured weight, plus queued count per priority class —
+        the fleet/router status surfaces render this directly."""
+        with self._lock:
+            tenants: Dict[str, Dict[str, float]] = {}
+            by_rank = [0] * len(PRIORITIES)
+            for q in self._queues:
+                for t, n in q.tenant_depths().items():
+                    row = tenants.setdefault(
+                        t, {"queued": 0, "weight": q.weight(t)}
+                    )
+                    row["queued"] += n
+                for rank, n in enumerate(q.rank_lens()):
+                    by_rank[rank] += n
+            for t, w in self._tenant_weights.items():
+                tenants.setdefault(t, {"queued": 0, "weight": w})
+            return {
+                "tenants": tenants,
+                "queued_by_priority": {
+                    p: by_rank[PRIORITY_RANK[p]] for p in PRIORITIES
+                },
+            }
+
     # -- service-time learning -------------------------------------------
 
     def observe_service(self, seconds: float) -> None:
@@ -182,10 +233,31 @@ class FleetScheduler:
         the seam tests and benches use to seed a known estimate)."""
         self._service.observe(seconds)
 
-    def estimated_wait(self) -> float:
+    def estimated_wait(self, rank: Optional[int] = None) -> float:
         """Deterministic completion estimate for a request admitted NOW
-        (see :meth:`ServiceEstimate.wait`) across the fleet's capacity."""
-        return self._service.wait(self._depth, self._n * self._policy.max_size)
+        (see :meth:`ServiceEstimate.wait`) across the fleet's capacity.
+
+        ``rank`` (a priority rank, 0 best) prices only the queue depth
+        at that priority or better — the depth that actually outranks
+        the request under priority-ordered dispatch. This is what makes
+        the shed ordering deterministic: low pays for everything queued,
+        high only for its own class, so at equal deadline slack low
+        sheds strictly first. ``None`` keeps the aggregate estimate."""
+        if rank is None:
+            depth = self._depth
+        else:
+            depth = 0
+            for q in self._queues:
+                lens = q.rank_lens()
+                depth += sum(lens[: rank + 1])
+        return self._service.wait(depth, self._n * self._policy.max_size)
+
+    def _rank_waits(self) -> List[float]:
+        """``estimated_wait`` per priority rank, computed once for the
+        requeue sweeps (lock held)."""
+        return [
+            self.estimated_wait(rank) for rank in range(len(PRIORITIES))
+        ]
 
     # -- admission -------------------------------------------------------
 
@@ -204,12 +276,14 @@ class FleetScheduler:
                     f"admission queue at capacity ({self._max_queue})"
                 )
             if req.deadline is not None:
-                est = self.estimated_wait()
+                est = self.estimated_wait(request_rank(req))
                 if time.monotonic() + est > req.deadline:
                     self._metrics.inc("shed")
+                    self._metrics.inc(f"shed.{req.priority}")
                     raise Shed(
                         f"deadline unmeetable at admission: estimated wait "
-                        f"{est:.4f}s exceeds the request's "
+                        f"{est:.4f}s (at priority {req.priority!r}) exceeds "
+                        f"the request's "
                         f"{max(req.deadline - time.monotonic(), 0):.4f}s budget"
                     )
             # shallowest LIVE queue: depth-balanced placement; drain-rate
@@ -306,6 +380,14 @@ class FleetScheduler:
                 # window closed with no arrival: dispatch what we have
                 if not own:
                     break
+        served: Dict[str, int] = {}
+        for r in batch:
+            t = request_tenant(r)
+            served[t] = served.get(t, 0) + 1
+        for t, n in served.items():
+            # per-tenant service counters: what the QoS status view's
+            # share column renders, summable across worker processes
+            self._metrics.inc(f"tenant.served.{t}", n)
         return batch
 
     def _maybe_steal(self, index: int) -> int:
@@ -344,6 +426,7 @@ class FleetScheduler:
 
     def _shed_requeued(self, req: _Request, est: float, now: float) -> None:
         self._metrics.inc("shed")
+        self._metrics.inc(f"shed.{getattr(req, 'priority', 'normal')}")
         settle_future(
             req.future,
             Shed(
@@ -368,7 +451,7 @@ class FleetScheduler:
             reqs = list(q)
             q.clear()
             now = time.monotonic()
-            est = self.estimated_wait()
+            ests = self._rank_waits()
             peers = [
                 i for i in range(self._n) if self._active[i] and i != index
             ]
@@ -377,6 +460,7 @@ class FleetScheduler:
                 if req.future.done():
                     self._depth -= 1
                     continue
+                est = ests[request_rank(req)]
                 if req.deadline is not None and now + est > req.deadline:
                     self._depth -= 1
                     self._shed_requeued(req, est, now)
@@ -424,7 +508,7 @@ class FleetScheduler:
         )
         with self._cond:
             now = time.monotonic()
-            est = self.estimated_wait()
+            ests = self._rank_waits()
             peers = [
                 i for i in range(self._n) if self._active[i] and i != index
             ]
@@ -434,6 +518,7 @@ class FleetScheduler:
             for req in reversed(list(requests)):
                 if req.future.done():
                     continue
+                est = ests[request_rank(req)]
                 if req.deadline is not None and now + est > req.deadline:
                     self._shed_requeued(req, est, now)
                     continue
@@ -456,6 +541,7 @@ class FleetScheduler:
                     datum=req.datum, deadline=req.deadline,
                     enqueued=req.enqueued, hops=req.hops + 1,
                     trace=req.trace,  # the retry keeps its identity
+                    priority=req.priority, tenant=req.tenant,
                 )
                 _chain_futures(clone.future, req.future)
                 self._queues[target].appendleft(clone)

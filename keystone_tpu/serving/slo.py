@@ -55,7 +55,12 @@ class SloPolicy:
 
     p99_budget_s / queue_age_p99_budget_s:
         End-to-end and time-queued p99 ceilings (seconds). Queue age is
-        the early-warning twin: it breaches before latency does.
+        the early-warning twin: it breaches before latency does. Judged
+        only in windows that saw traffic (a ``submitted`` or
+        ``completed`` counter delta): the reservoirs are cumulative, so
+        a quiet window's quantiles are a PAST burst's evidence — left
+        unjudged, or an autoscaler fed by these breaches would hold a
+        long-idle fleet at peak size forever.
     max_shed_rate:
         Ceiling on the share of OFFERED traffic refused within one
         sample window: ``(shed + rejected) / (submitted + shed +
@@ -95,15 +100,23 @@ class SloPolicy:
         def breach(objective: str, observed, budget) -> None:
             out.append(SloBreach(objective, float(observed), float(budget), ts))
 
+        # latency/queue-age quantiles come from cumulative reservoirs:
+        # only a window that saw traffic may be judged by them (see the
+        # class docstring — stale evidence must not breach forever)
+        active = (
+            counters.get("submitted", 0) + counters.get("completed", 0) > 0
+        )
         lat = row.get("latency") or {}
         if (
-            self.p99_budget_s is not None
+            active
+            and self.p99_budget_s is not None
             and lat.get("p99", 0.0) > self.p99_budget_s
         ):
             breach("p99_budget_s", lat["p99"], self.p99_budget_s)
         age = row.get("queue_age") or {}
         if (
-            self.queue_age_p99_budget_s is not None
+            active
+            and self.queue_age_p99_budget_s is not None
             and age.get("p99", 0.0) > self.queue_age_p99_budget_s
         ):
             breach(

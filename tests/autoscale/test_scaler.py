@@ -1,0 +1,303 @@
+"""The breach-driven autoscaler control plane, unit-tested against a
+stub actuator: policy validation, breach hysteresis, cooldowns,
+idle-driven scale-down, the cold-fleet guard, and the chaos seam —
+a kill injected mid-scale-up reaps the half-born slot, lands a
+``scale.abort`` instant, and the next tick converges the fleet."""
+
+import time
+from dataclasses import FrozenInstanceError
+
+import pytest
+
+import keystone_tpu.faults as faults
+from keystone_tpu.autoscale import Autoscaler, ScalePolicy
+from keystone_tpu.obs import flight
+from keystone_tpu.serving.metrics import MetricsRegistry
+from keystone_tpu.serving.slo import SloBreach
+
+
+class StubActuator:
+    """The five actuator verbs, recording every call. ``admitting`` /
+    ``booting`` / ``draining`` are plain counters the verbs move, so a
+    tick sequence drives a tiny fleet simulation with no processes."""
+
+    def __init__(self, admitting=1, estimate=0.01):
+        self.service_estimate = estimate
+        self.admitting = admitting
+        self.booting = 0
+        self.draining = 0
+        self.next_index = admitting
+        self.calls = []
+
+    def scale_view(self):
+        return {
+            "admitting": self.admitting,
+            "booting": self.booting,
+            "draining": self.draining,
+        }
+
+    def scale_up_slot(self):
+        idx = self.next_index
+        self.next_index += 1
+        self.booting += 1
+        self.calls.append(("scale_up_slot", idx))
+        return idx
+
+    def pick_drain_candidate(self):
+        return self.admitting - 1 if self.admitting > 0 else None
+
+    def begin_drain(self, index):
+        self.calls.append(("begin_drain", index))
+        self.admitting -= 1
+        self.draining += 1
+
+    def reap_slot(self, index):
+        self.calls.append(("reap_slot", index))
+        self.booting = max(0, self.booting - 1)
+
+    # test conveniences
+    def finish_boots(self):
+        self.admitting += self.booting
+        self.booting = 0
+
+    def finish_drains(self):
+        self.draining = 0
+
+
+def breach(observed=2.0, budget=1.0):
+    return SloBreach(
+        objective="queue_age_budget_s", observed=observed, budget=budget,
+        ts=time.time(),
+    )
+
+
+def idle_row(depth=0.0):
+    return {"gauges": {"queue_depth": depth}}
+
+
+FAST = dict(up_cooldown_s=0.0, down_cooldown_s=0.0, breach_window_s=60.0)
+
+
+# -- policy ---------------------------------------------------------------
+
+
+def test_policy_validates_bounds():
+    with pytest.raises(ValueError):
+        ScalePolicy(min_workers=0)
+    with pytest.raises(ValueError):
+        ScalePolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError):
+        ScalePolicy(up_breaches=0)
+    with pytest.raises(ValueError):
+        ScalePolicy(down_after_idle_ticks=0)
+
+
+def test_policy_is_frozen_plain_data():
+    p = ScalePolicy(min_workers=2, max_workers=8)
+    with pytest.raises(FrozenInstanceError):
+        p.max_workers = 99
+    d = p.as_dict()
+    assert d["min_workers"] == 2 and d["max_workers"] == 8
+    assert p.clamp(0) == 2 and p.clamp(100) == 8 and p.clamp(5) == 5
+
+
+# -- cold guard -----------------------------------------------------------
+
+
+def test_cold_fleet_never_scales():
+    act = StubActuator(admitting=0, estimate=None)
+    scaler = Autoscaler(ScalePolicy(min_workers=2, **FAST), act)
+    # below min AND breaching — but no learned service estimate, so the
+    # scaler must not move (same contract as cold admission: no pricing
+    # evidence, no action)
+    assert scaler.tick([breach(), breach()]) == []
+    assert act.calls == []
+    assert scaler.target_workers is None
+
+
+# -- breach hysteresis ----------------------------------------------------
+
+
+def test_one_breach_is_not_enough_two_buy_a_worker():
+    act = StubActuator(admitting=1)
+    scaler = Autoscaler(ScalePolicy(up_breaches=2, **FAST), act)
+    assert scaler.tick([breach()]) == []
+    decisions = scaler.tick([breach()])
+    assert [d.action for d in decisions] == ["up"]
+    d = decisions[0]
+    assert d.ok and d.reason == "breach"
+    assert (d.from_workers, d.to_workers) == (1, 2)
+    assert d.worker == 1
+    assert d.trigger["objective"] == "queue_age_budget_s"
+    assert ("scale_up_slot", 1) in act.calls
+    assert scaler.target_workers == 2
+
+
+def test_scale_up_clears_the_breach_window():
+    act = StubActuator(admitting=1)
+    scaler = Autoscaler(ScalePolicy(up_breaches=2, **FAST), act)
+    assert len(scaler.tick([breach(), breach()])) == 1
+    act.finish_boots()
+    # the old evidence was spent on worker 1; a single fresh breach must
+    # not buy worker 2
+    assert scaler.tick([breach()]) == []
+    assert scaler.tick([breach()]) != []
+
+
+def test_up_cooldown_blocks_a_repeat_up():
+    act = StubActuator(admitting=1)
+    scaler = Autoscaler(
+        ScalePolicy(up_breaches=1, up_cooldown_s=3600.0, down_cooldown_s=0.0),
+        act,
+    )
+    assert len(scaler.tick([breach()])) == 1
+    act.finish_boots()
+    assert scaler.tick([breach()]) == []  # still cooling down
+    assert len(act.calls) == 1
+
+
+def test_max_workers_is_a_hard_ceiling():
+    act = StubActuator(admitting=3)
+    scaler = Autoscaler(ScalePolicy(max_workers=3, up_breaches=1, **FAST), act)
+    assert scaler.tick([breach(), breach()]) == []
+    assert act.calls == []
+
+
+def test_below_min_restores_without_breaches():
+    act = StubActuator(admitting=1)
+    scaler = Autoscaler(ScalePolicy(min_workers=2, **FAST), act)
+    decisions = scaler.tick()
+    assert [d.reason for d in decisions] == ["below_min"]
+    assert decisions[0].trigger == {}
+
+
+# -- idle scale-down ------------------------------------------------------
+
+
+def test_consecutive_idle_ticks_drain_one_worker():
+    act = StubActuator(admitting=3)
+    scaler = Autoscaler(
+        ScalePolicy(down_after_idle_ticks=3, **FAST), act
+    )
+    assert scaler.tick(row=idle_row()) == []
+    assert scaler.tick(row=idle_row()) == []
+    decisions = scaler.tick(row=idle_row())
+    assert [d.action for d in decisions] == ["down"]
+    d = decisions[0]
+    assert d.ok and d.reason == "idle" and d.worker == 2
+    assert (d.from_workers, d.to_workers) == (3, 2)
+    assert ("begin_drain", 2) in act.calls
+
+
+def test_a_loaded_tick_resets_the_idle_run():
+    act = StubActuator(admitting=3)
+    scaler = Autoscaler(ScalePolicy(down_after_idle_ticks=2, **FAST), act)
+    assert scaler.tick(row=idle_row()) == []
+    # queue depth above the idle threshold: the run restarts
+    assert scaler.tick(row=idle_row(depth=5.0)) == []
+    assert scaler.tick(row=idle_row()) == []
+    assert len(scaler.tick(row=idle_row())) == 1
+
+
+def test_min_workers_is_a_hard_floor_for_drains():
+    act = StubActuator(admitting=1)
+    scaler = Autoscaler(ScalePolicy(down_after_idle_ticks=1, **FAST), act)
+    for _ in range(5):
+        assert scaler.tick(row=idle_row()) == []
+    assert act.calls == []
+
+
+def test_down_cooldown_spaces_out_drains():
+    act = StubActuator(admitting=4)
+    scaler = Autoscaler(
+        ScalePolicy(
+            down_after_idle_ticks=1, up_cooldown_s=0.0,
+            down_cooldown_s=3600.0,
+        ),
+        act,
+    )
+    assert len(scaler.tick(row=idle_row())) == 1
+    act.finish_drains()
+    for _ in range(5):
+        assert scaler.tick(row=idle_row()) == []
+    assert len(act.calls) == 1
+
+
+# -- evidence -------------------------------------------------------------
+
+
+def test_decisions_land_as_counters_instants_and_rows():
+    flight.reset()
+    metrics = MetricsRegistry()
+    act = StubActuator(admitting=2)
+    scaler = Autoscaler(
+        ScalePolicy(up_breaches=1, down_after_idle_ticks=1, **FAST),
+        act, metrics=metrics,
+    )
+    scaler.tick([breach()])
+    act.finish_boots()
+    scaler.tick(row=idle_row())
+    counters = metrics.snapshot()["counters"]
+    assert counters["scale_ups"] == 1 and counters["scale_downs"] == 1
+    names = [e["name"] for e in flight.recorder().entries()]
+    assert "scale.up" in names and "scale.down" in names
+    rows = [d.as_row() for d in scaler.decisions]
+    assert [r["action"] for r in rows] == ["up", "down"]
+    assert all(
+        {"ok", "reason", "from_workers", "to_workers", "ts"} <= set(r)
+        for r in rows
+    )
+    desc = scaler.describe()
+    assert desc["policy"]["up_breaches"] == 1
+    assert len(desc["decisions"]) == 2
+
+
+# -- chaos: kill mid-scale-up ---------------------------------------------
+
+
+def test_kill_mid_scale_up_reaps_and_converges():
+    flight.reset()
+    metrics = MetricsRegistry()
+    act = StubActuator(admitting=1)
+    scaler = Autoscaler(
+        ScalePolicy(up_breaches=1, **FAST), act, metrics=metrics
+    )
+    faults.install(faults.parse_plan("scale.spawn=kill@0"))
+    try:
+        decisions = scaler.tick([breach()])
+    finally:
+        faults.clear()
+    # the apply was aborted: half-born slot 1 reaped, fleet unchanged
+    assert [d.ok for d in decisions] == [False]
+    d = decisions[0]
+    assert d.action == "up" and d.worker == 1
+    assert (d.from_workers, d.to_workers) == (1, 1)
+    assert "cause" in d.trigger
+    assert ("reap_slot", 1) in act.calls
+    assert act.booting == 0 and act.admitting == 1
+    assert metrics.snapshot()["counters"]["scale_aborts"] == 1
+    # the recovery instant the lint pairs with the scale.spawn site
+    names = [e["name"] for e in flight.recorder().entries()]
+    assert "scale.abort" in names
+    # fresh evidence converges the fleet back toward the policy target
+    decisions = scaler.tick([breach()])
+    assert [d.ok for d in decisions] == [True]
+    assert act.booting == 1
+    assert scaler.target_workers == 2
+
+
+def test_kill_mid_drain_reaps_the_half_drained_slot():
+    flight.reset()
+    act = StubActuator(admitting=2)
+    scaler = Autoscaler(
+        ScalePolicy(down_after_idle_ticks=1, **FAST), act
+    )
+    faults.install(faults.parse_plan("scale.drain=kill@0"))
+    try:
+        decisions = scaler.tick(row=idle_row())
+    finally:
+        faults.clear()
+    assert [d.ok for d in decisions] == [False]
+    assert ("reap_slot", 1) in act.calls
+    names = [e["name"] for e in flight.recorder().entries()]
+    assert "scale.abort" in names

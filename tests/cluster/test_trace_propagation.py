@@ -73,15 +73,26 @@ def _events_by_trace(span_sets):
 
 def test_a_one_request_stitches_across_three_hops(router, data):
     router.predict(data[0], timeout=30.0)
-    span_sets = router.collect_trace(timeout=10.0)
-    by_trace = _events_by_trace(span_sets)
+    # the worker records its spans as the reply leaves: a stats
+    # round-trip racing the reply can miss them, and collection
+    # ACCUMULATES, so poll until the fullest trace is whole (a lost
+    # race only means the spans arrive on a later round-trip)
+    deadline = time.monotonic() + 10.0
+    while True:
+        span_sets = router.collect_trace(timeout=10.0)
+        by_trace = _events_by_trace(span_sets)
+        if by_trace:
+            tid, spans = max(
+                by_trace.items(),
+                key=lambda kv: len({s["name"] for s in kv[1]}),
+            )
+            names = {s["name"] for s in spans}
+            if names >= ROUTER_HOPS | WORKER_HOPS:
+                break
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.1)
     assert by_trace, "no trace ids propagated"
-    # pick a trace that has worker-side spans (the stats round-trip in
-    # collect_trace shipped them)
-    tid, spans = max(
-        by_trace.items(), key=lambda kv: len({s["name"] for s in kv[1]})
-    )
-    names = {s["name"] for s in spans}
     pids = {s["pid"] for s in spans}
     assert names >= ROUTER_HOPS | WORKER_HOPS, names
     assert len(pids) >= 2, pids  # router + worker process tracks

@@ -26,18 +26,39 @@ def test_unset_objectives_are_not_evaluated():
 
 def test_p99_and_queue_age_budgets():
     pol = SloPolicy(p99_budget_s=0.5, queue_age_p99_budget_s=0.1)
+    traffic = {"completed": 4}
     ok = pol.evaluate(
-        _row(latency={"p99": 0.4}, queue_age={"p99": 0.05})
+        _row(
+            latency={"p99": 0.4}, queue_age={"p99": 0.05},
+            counters=traffic,
+        )
     )
     assert ok == []
     bad = pol.evaluate(
-        _row(latency={"p99": 0.6}, queue_age={"p99": 0.2})
+        _row(
+            latency={"p99": 0.6}, queue_age={"p99": 0.2},
+            counters=traffic,
+        )
     )
     assert [b.objective for b in bad] == [
         "p99_budget_s", "queue_age_p99_budget_s"
     ]
     assert bad[0].observed == 0.6 and bad[0].budget == 0.5
     assert bad[0].ts == 100.0
+
+
+def test_latency_budgets_judge_only_windows_with_traffic():
+    # the reservoirs are cumulative: a quiet window re-showing a past
+    # burst's p99 is stale evidence, not a fresh breach (it would hold
+    # a breach-driven autoscaler at peak size forever)
+    pol = SloPolicy(p99_budget_s=0.5, queue_age_p99_budget_s=0.1)
+    assert pol.evaluate(
+        _row(latency={"p99": 9.0}, queue_age={"p99": 9.0})
+    ) == []
+    (b,) = pol.evaluate(
+        _row(latency={"p99": 9.0}, counters={"submitted": 1})
+    )
+    assert b.objective == "p99_budget_s"
 
 
 def test_shed_rate_judged_only_with_traffic():
@@ -80,6 +101,7 @@ def test_watchdog_tick_samples_judges_and_emits():
     reg = MetricsRegistry(name="fleet")
     for _ in range(8):
         reg.observe_latency(2.0)
+        reg.inc("completed")
     dog = SloWatchdog(reg, SloPolicy(p99_budget_s=1.0), source="test-tier")
     found = dog.tick()
     assert [b.objective for b in found] == ["p99_budget_s"]
