@@ -26,6 +26,11 @@
 # scaled worker back DOWN after the idle cooldown, with both decisions
 # rendered in the --status view's autoscale section and zero requests
 # failed around either transition.
+# Boot 8 closes the accounting/export loop: a live router with the
+# Prometheus exposition endpoint enabled (metrics_port=0) is scraped
+# mid-demo — the text must parse, carry # TYPE lines, agree with the
+# merged snapshot's submitted counter, and render the per-tenant
+# cost families the attribution plane charges.
 # Boot 6 closes the continual-learning loop: a fleet + trainer daemon
 # (keystone_tpu/trainer/) with live traffic while chunk batches append —
 # every good batch must canary-pass and PROMOTE a refreshed model, the
@@ -198,5 +203,75 @@ print(
     "AUTOSCALE STAGE OK: scaled 1->2 on breaches, drained 2->1 on idle, "
     f"zero failed requests (scale_ups={c['scale_ups']}, "
     f"scale_downs={c['scale_downs']})"
+)
+PY
+echo "== boot 8 (export plane: live scrape parses and matches the merged snapshot) =="
+env JAX_PLATFORMS=cpu python - <<'PY'
+import re
+import urllib.request
+
+import numpy as np
+
+from keystone_tpu.cluster import ClusterRouter
+
+d = 64
+spec = (
+    "factory", "keystone_tpu.cluster.demo:build_stall_model",
+    {"d": d, "stall_s": 0.001},
+)
+data = np.random.RandomState(7).randn(16, d).astype(np.float32)
+router = ClusterRouter(
+    spec, workers=1, replicas_per_worker=1, buckets=(8,),
+    datum_shape=(d,), max_wait_ms=2.0, max_queue=1024,
+    spawn_timeout_s=300, health_interval_s=0.25,
+    tenant_weights={"gold": 3.0, "bronze": 1.0},
+    metrics_port=0,
+)
+n = 48
+with router:
+    host, port = router.metrics_address
+    for i in range(n):
+        tenant = "gold" if i % 2 else "bronze"
+        router.submit(
+            data[i % len(data)], tenant=tenant, timeout=30.0
+        ).result()
+    with urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=10
+    ) as resp:
+        assert resp.status == 200, resp.status
+        body = resp.read().decode("utf-8")
+    snap = router.snapshot()
+
+sample = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+$"
+)
+samples = {}
+typed = 0
+for line in body.splitlines():
+    if not line:
+        continue
+    if line.startswith("# TYPE "):
+        typed += 1
+        continue
+    if line.startswith("#"):
+        continue
+    assert sample.match(line), f"malformed exposition line: {line!r}"
+    key, value = line.rsplit(" ", 1)
+    samples[key] = float(value)
+assert typed > 0, "no # TYPE lines in the scrape"
+submitted = samples["keystone_submitted_total"]
+assert submitted == snap["counters"]["submitted"] == n, (
+    submitted, snap["counters"].get("submitted"), n,
+)
+cost_keys = [
+    k for k in samples
+    if k.startswith("keystone_tenant_device_seconds_total{")
+]
+assert any('tenant="gold"' in k for k in cost_keys), sorted(samples)[:40]
+assert any('tenant="bronze"' in k for k in cost_keys), cost_keys
+print(
+    f"SCRAPE STAGE OK: {len(samples)} samples, {typed} families, "
+    f"submitted={int(submitted)} matches the merged snapshot, "
+    f"{len(cost_keys)} per-tenant device-second series"
 )
 PY

@@ -55,6 +55,13 @@ logger = logging.getLogger(__name__)
 #: decisions kept for the status view
 _MAX_DECISIONS = 64
 
+#: SLO objectives that do NOT evidence a capacity shortage — adding a
+#: worker cannot fix a tenant blowing its spend budget or one process's
+#: device-memory footprint, so their breaches never buy scale-ups
+NON_CAPACITY_OBJECTIVES = frozenset(
+    {"tenant_device_s_budget", "device_mem_budget_bytes"}
+)
+
 
 @dataclass(frozen=True)
 class ScaleDecision:
@@ -122,6 +129,13 @@ class Autoscaler:
             return []
         now = time.monotonic()
         for b in breaches or ():
+            if getattr(b, "objective", None) in NON_CAPACITY_OBJECTIVES:
+                # a tenant overspending its device-second budget or a
+                # per-process memory watermark is not a capacity
+                # shortage: buying a worker fixes neither, so these
+                # breaches warn (flight/status/counters) without feeding
+                # the scale-up hysteresis
+                continue
             self._breach_window.append((now, b))
         horizon = now - self.policy.breach_window_s
         while self._breach_window and self._breach_window[0][0] < horizon:

@@ -93,6 +93,7 @@ from ..utils import env_int as _env_int
 from . import wire as wire_mod
 from .wire import (
     ConnectionClosed,
+    costs_from_wire,
     deadline_to_wire,
     decode_error,
     qos_to_wire,
@@ -207,6 +208,7 @@ class ClusterRouter:
         trace_sample: Optional[float] = None,
         autoscale: Optional[ScalePolicy] = None,
         tenant_weights: Optional[Dict[str, float]] = None,
+        metrics_port: Optional[int] = None,
     ):
         self._n = workers if workers is not None else default_workers()
         if self._n < 1:
@@ -279,6 +281,14 @@ class ClusterRouter:
         self._own_trace_spans: List[dict] = []
         self._own_span_cursor = 0
         self._own_trace_lock = threading.Lock()
+        #: Prometheus scrape plane: metrics_port= wins, else
+        #: KEYSTONE_METRICS_PORT; 0 binds an ephemeral port, unset (or a
+        #: negative env value) disables the endpoint entirely
+        if metrics_port is None:
+            env_port = _env_int("KEYSTONE_METRICS_PORT", -1, minimum=-1)
+            metrics_port = env_port if env_port >= 0 else None
+        self._metrics_port = metrics_port
+        self._exporter = None
 
     @staticmethod
     def _resolve_model_spec(model) -> tuple:
@@ -338,6 +348,14 @@ class ClusterRouter:
     def autoscaler(self) -> Optional[Autoscaler]:
         """The riding scaler, None without an ``autoscale`` policy."""
         return self._autoscaler
+
+    @property
+    def metrics_address(self) -> Optional[tuple]:
+        """``(host, port)`` of the Prometheus scrape endpoint, None when
+        the export plane is disabled (no ``metrics_port`` and no
+        ``KEYSTONE_METRICS_PORT``)."""
+        exporter = self._exporter
+        return exporter.address if exporter is not None else None
 
     @property
     def live_workers(self) -> int:
@@ -425,6 +443,17 @@ class ClusterRouter:
             target=self._health_loop, name="ks-router-health", daemon=True
         )
         self._health_thread.start()
+        if self._metrics_port is not None:
+            # the scrape plane serves the MERGED fleet snapshot the
+            # router already computes: a scrape is one stats round-trip,
+            # never a touch on the request path
+            from ..obs.prom import PrometheusExporter
+
+            self._exporter = PrometheusExporter(
+                lambda: self.snapshot(timeout=2.0),
+                port=self._metrics_port,
+            )
+            self._exporter.start()
         logger.info(
             "cluster router up on 127.0.0.1:%d — %d worker(s), "
             "capacity %d", self._port, self._n, self.capacity,
@@ -571,6 +600,16 @@ class ClusterRouter:
                         est = msg.get("service_estimate")
                         if est is not None:
                             self._service.observe(float(est))
+                    # fold the worker's cost DELTAS into the router's own
+                    # registry: the health-loop timeline (and the SLO
+                    # watchdog's per-tenant spend budget) then sees
+                    # fleet-wide charges continuously. snapshot() strips
+                    # this mirror before merging so worker tables stay
+                    # the single authoritative count.
+                    for tenant, priority, cost in costs_from_wire(
+                        msg.get("costs")
+                    ):
+                        self._metrics.observe_cost(tenant, priority, **cost)
                 elif kind == "stats":
                     if msg.get("spans_dropped"):
                         logger.warning(
@@ -1283,6 +1322,11 @@ class ClusterRouter:
         every live worker's snapshot (batches, occupancy, worker-side
         sheds, queue-age sketches) via :meth:`MetricsRegistry.merge`."""
         own = self._metrics.snapshot(sketches=True)
+        # the router's cost table is a pong-fed MIRROR of the workers'
+        # (kept so the router-side timeline/watchdog track spend live);
+        # merging it alongside the authoritative worker tables would
+        # double every charge
+        own.pop("costs", None)
         workers = self.worker_snapshots(timeout=timeout)
         # every completed request has a latency sample in BOTH tiers
         # (router end-to-end, worker-internal) — merging both sketches
@@ -1436,6 +1480,7 @@ class ClusterRouter:
             "outstanding": self.outstanding,
             "capacity": self.capacity,
             "counters": snap.get("counters", {}),
+            "costs": snap.get("costs", {}),
             "latency": snap.get("latency", {}),
             "queue_age": snap.get("queue_age", {}),
             "batch_occupancy": snap.get("batch_occupancy"),
@@ -1505,6 +1550,9 @@ class ClusterRouter:
                 return
             self._closed = True
             self._cond.notify_all()
+        exporter, self._exporter = self._exporter, None
+        if exporter is not None:
+            exporter.stop()
         if drain:
             deadline = time.monotonic() + self._drain_timeout_s
             with self._cond:
@@ -1650,6 +1698,31 @@ def format_status(status: dict) -> str:
                 f"{p}={sheds.get(p, 0)}" for p in ("high", "normal", "low")
             )
         )
+    costs = status.get("costs") or {}
+    if costs:
+        for tenant, prios in sorted(costs.items()):
+            total = {
+                "device_s": 0.0, "queue_s": 0.0,
+                "payload_bytes": 0, "items": 0,
+            }
+            for row in prios.values():
+                for k in total:
+                    total[k] += row.get(k) or 0
+            split = " ".join(
+                f"{p}={round(r.get('device_s') or 0.0, 4)}s"
+                for p, r in sorted(prios.items())
+            )
+            lines.append(
+                "  cost [{}]: device_s={} queue_s={} payload_mb={} "
+                "items={} ({})".format(
+                    tenant,
+                    round(total["device_s"], 4),
+                    round(total["queue_s"], 4),
+                    round(total["payload_bytes"] / 1e6, 3),
+                    int(total["items"]),
+                    split,
+                )
+            )
     plat = qos.get("priority_latency") or {}
     if plat:
         lines.append(
@@ -1692,8 +1765,13 @@ def format_status(status: dict) -> str:
         lines.append(f"  slo policy: {slo.get('policy')}")
         for b in (slo.get("breaches") or [])[-8:]:
             lines.append(
-                "    BREACH {objective}: observed {observed} vs budget "
-                "{budget}".format(**b)
+                "    BREACH {objective}{who}: observed {observed} vs "
+                "budget {budget}".format(
+                    who=(
+                        " [{}]".format(b["detail"]) if b.get("detail") else ""
+                    ),
+                    **{k: v for k, v in b.items() if k != "detail"},
+                )
             )
     for name, rows in sorted((status.get("timelines") or {}).items()):
         lines.append(f"  timeline [{name}] ({len(rows)} samples):")

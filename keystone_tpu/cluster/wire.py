@@ -220,6 +220,56 @@ def qos_from_wire(msg: dict) -> "tuple[str, str]":
     )
 
 
+# -- cost accounting across the boundary -------------------------------------
+
+
+def costs_to_wire(table: Optional[dict]) -> Optional[dict]:
+    """A cost-table slice (``{tenant: {priority: {device_s, queue_s,
+    payload_bytes, items}}}``) as the compact wire form ``{tenant:
+    {priority: [device_s, queue_s, payload_bytes, items]}}`` — rows that
+    charge nothing are dropped, and an empty table ships as None so the
+    pong frame stays minimal on idle workers."""
+    out: dict = {}
+    for tenant, prios in (table or {}).items():
+        for priority, row in (prios or {}).items():
+            vals = [
+                round(float(row.get("device_s") or 0.0), 6),
+                round(float(row.get("queue_s") or 0.0), 6),
+                int(row.get("payload_bytes") or 0),
+                int(row.get("items") or 0),
+            ]
+            if any(vals):
+                out.setdefault(str(tenant), {})[str(priority)] = vals
+    return out or None
+
+
+def costs_from_wire(payload: Optional[dict]) -> list:
+    """Wire cost rows → ``[(tenant, priority, {field: value})]``;
+    malformed rows (a pre-accounting peer, a truncated frame) decode as
+    an empty list rather than poisoning the pong handler."""
+    rows = []
+    for tenant, prios in (payload or {}).items():
+        if not isinstance(prios, dict):
+            continue
+        for priority, vals in prios.items():
+            if not isinstance(vals, (list, tuple)) or len(vals) < 4:
+                continue
+            try:
+                rows.append((
+                    str(tenant),
+                    str(priority),
+                    {
+                        "device_s": float(vals[0]),
+                        "queue_s": float(vals[1]),
+                        "payload_bytes": int(vals[2]),
+                        "items": int(vals[3]),
+                    },
+                ))
+            except (TypeError, ValueError):
+                continue
+    return rows
+
+
 # -- typed errors across the boundary ----------------------------------------
 
 

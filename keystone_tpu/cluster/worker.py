@@ -111,6 +111,7 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     from ..serving import ServingFleet
     from .wire import (
         ConnectionClosed,
+        costs_to_wire,
         deadline_from_wire,
         encode_error,
         qos_from_wire,
@@ -129,6 +130,24 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     tracer = _obs_tracer.start() if spec.get("trace") else None
     process_name = f"keystone:worker-{worker_id}/{os.getpid()}"
     span_cursor = [0]  # spans_since bookmark: each span ships once
+    # (tenant, priority) -> last-shipped cumulative cost row: pongs ship
+    # deltas so the router can fold them additively without re-counting
+    cost_cursor: dict = {}
+
+    def _cost_deltas(cursor: dict, table: dict) -> dict:
+        out: dict = {}
+        for tenant, prios in table.items():
+            for priority, row in prios.items():
+                prev = cursor.get((tenant, priority)) or {}
+                delta = {
+                    k: row.get(k, 0) - prev.get(k, 0)
+                    for k in ("device_s", "queue_s", "payload_bytes", "items")
+                }
+                cursor[(tenant, priority)] = dict(row)
+                if any(v > 1e-9 if isinstance(v, float) else v
+                       for v in delta.values()):
+                    out.setdefault(tenant, {})[priority] = delta
+        return out
 
     sock = socket.create_connection((host, port), timeout=30.0)
     # bounded sends, timeout-tolerant receives (see wire.SEND_TIMEOUT_S)
@@ -295,11 +314,21 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
                 # the router's health cadence doubles as the worker's
                 # metrics-timeline sampler: one row per ping
                 fleet.metrics.sample_timeline()
-                reply({
+                pong = {
                     "type": "pong",
                     "t": msg.get("t"),
                     "service_estimate": fleet.scheduler.service_estimate,
-                })
+                }
+                # per-tenant cost DELTAS since the last pong ride the
+                # health cadence, so the router's own timeline (and its
+                # SloWatchdog's tenant-spend budget) tracks fleet-wide
+                # spend continuously, not just on stats round-trips
+                table = fleet.metrics.cost_table()
+                deltas = _cost_deltas(cost_cursor, table)
+                wired = costs_to_wire(deltas)
+                if wired:
+                    pong["costs"] = wired
+                reply(pong)
             elif kind == "stats":
                 # a stats round-trip always carries a fresh timeline row
                 # (pings drive the steady cadence; an early status() call

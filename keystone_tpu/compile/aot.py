@@ -87,6 +87,12 @@ class AotDispatcher:
         self._lock = threading.Lock()
         self._loaded = 0
         self._traced = 0
+        # the persistent compile ledger lives next to the cache entries:
+        # every trace/export/load lands with duration + bytes (the
+        # residency-budget evidence; appends never raise)
+        from ..obs.ledger import CompileLedger
+
+        self._ledger = CompileLedger.for_cache_root(cache.root)
 
     # -- introspection --------------------------------------------------
 
@@ -149,6 +155,17 @@ class AotDispatcher:
             self._cache._discard(entry.path, "undeserializable")
             return None
         self._loaded += 1
+        load_seconds = time.perf_counter() - t0
+        self._ledger.record(
+            "load",
+            key=key,
+            label=self._label,
+            shape=list(sig[0]),
+            dtype=sig[1],
+            nbytes=entry.nbytes,
+            seconds=load_seconds,
+            saved_s=entry.header.get("trace_seconds"),
+        )
         tracer = _trace_current()
         if tracer is not None:
             tracer.instant(
@@ -159,7 +176,7 @@ class AotDispatcher:
                 shape=list(sig[0]),
                 dtype=sig[1],
                 bytes=entry.nbytes,
-                load_seconds=round(time.perf_counter() - t0, 4),
+                load_seconds=round(load_seconds, 4),
                 seconds_saved=entry.header.get("trace_seconds"),
             )
         logger.info(
@@ -219,6 +236,14 @@ class AotDispatcher:
             return jax.jit(traced)
         trace_seconds = time.perf_counter() - t0
         self._traced += 1
+        self._ledger.record(
+            "trace",
+            key=key,
+            label=self._label,
+            shape=list(sig[0]),
+            dtype=sig[1],
+            seconds=trace_seconds,
+        )
         try:
             payload = bytes(exported.serialize())
             self._cache.store(
@@ -245,6 +270,16 @@ class AotDispatcher:
                 "live", self._label or key, sig, exc_info=True,
             )
             payload = b""
+        if payload:
+            self._ledger.record(
+                "export",
+                key=key,
+                label=self._label,
+                shape=list(sig[0]),
+                dtype=sig[1],
+                nbytes=len(payload),
+                seconds=trace_seconds,
+            )
         if tracer is not None:
             tracer.instant(
                 "aot.export",

@@ -75,6 +75,18 @@ class ExecutableCache:
             )
         self.max_bytes = int(max_bytes)
         os.makedirs(self.entries_dir, exist_ok=True)
+        # the compile ledger shares the cache root: cache-layer movements
+        # (hit/store/evict) interleave with the dispatcher's
+        # trace/export/load events in one accounting stream
+        from ..obs.ledger import CompileLedger
+
+        self._ledger = CompileLedger.for_cache_root(self.root)
+
+    @property
+    def ledger(self):
+        """The :class:`~keystone_tpu.obs.ledger.CompileLedger` riding
+        this cache root (``compile-ledger.ndjson``)."""
+        return self._ledger
 
     @property
     def entries_dir(self) -> str:
@@ -122,6 +134,7 @@ class ExecutableCache:
             except OSError:
                 pass
             raise
+        self._ledger.record("store", key=key, nbytes=len(payload))
         self._evict(keep=key)
         return path
 
@@ -187,6 +200,7 @@ class ExecutableCache:
             os.utime(path)  # LRU recency; racing an eviction is benign
         except OSError:
             pass
+        self._ledger.record("hit", key=key, nbytes=entry.nbytes)
         return entry
 
     def _parse(self, key: str, data: bytes, path: str) -> Optional[CacheEntry]:
@@ -260,6 +274,7 @@ class ExecutableCache:
                 os.unlink(self.entry_path(key))
             except OSError:
                 continue
+            self._ledger.record("evict", key=key, nbytes=size)
             total -= size
             removed += 1
         if removed:

@@ -84,6 +84,51 @@ def _dump_dir() -> str:
     return env_str("KEYSTONE_FLIGHT_DIR") or tempfile.gettempdir()
 
 
+def _dump_keep() -> int:
+    from ..utils import env_int
+
+    return env_int("KEYSTONE_FLIGHT_KEEP", 32)
+
+
+def _prune_dumps(dump_dir: str, keep: int) -> int:
+    """Bounded retention for auto-named dumps: keep the newest ``keep``
+    ``keystone-flight-*.json`` files in ``dump_dir``, deleting
+    oldest-first (by mtime). Chaos benches dump on every kill; an
+    unbounded KEYSTONE_FLIGHT_DIR fills with hundreds of rings nobody
+    will read. Best-effort: a file another process already reaped (or a
+    permission surprise) is skipped, never raised. Returns the number
+    deleted."""
+    try:
+        names = [
+            n
+            for n in os.listdir(dump_dir)
+            if n.startswith("keystone-flight-") and n.endswith(".json")
+        ]
+    except OSError:
+        logger.debug("flight retention: cannot list %s", dump_dir,
+                     exc_info=True)
+        return 0
+    if len(names) <= keep:
+        return 0
+    stamped = []
+    for n in names:
+        full = os.path.join(dump_dir, n)
+        try:
+            stamped.append((os.path.getmtime(full), full))
+        except OSError:
+            continue  # raced another pruner; already gone
+    stamped.sort()
+    deleted = 0
+    for _, full in stamped[: max(0, len(stamped) - keep)]:
+        try:
+            os.unlink(full)
+            deleted += 1
+        except OSError:
+            logger.debug("flight retention: unlink %s failed", full,
+                         exc_info=True)
+    return deleted
+
+
 class FlightRecorder:
     """A lock-cheap bounded ring of span summaries + instants."""
 
@@ -167,6 +212,7 @@ class FlightRecorder:
             "dropped_before_window": dropped,
             "entries": entries,
         }
+        auto_named = path is None
         if path is None:
             path = os.path.join(
                 _dump_dir(),
@@ -190,6 +236,10 @@ class FlightRecorder:
             "flight recorder: %d entries -> %s (trigger: %s)",
             len(entries), path, trigger,
         )
+        if auto_named:
+            # retention applies only to the managed dump dir — an
+            # explicit path= target is the caller's file to manage
+            _prune_dumps(os.path.dirname(path), _dump_keep())
         return path
 
 
@@ -226,6 +276,13 @@ def record_span(name: str, seconds: float, **attrs) -> None:
 
 def record_instant(name: str, **attrs) -> None:
     recorder().record_instant(name, **attrs)
+    # the structured-event sink rides the same call: every flight
+    # instant (restarts, SLO breaches, autoscale decisions, rollbacks)
+    # is exactly the event stream an external collector wants, and the
+    # sink is a no-op unless KEYSTONE_EVENTS names a path
+    from . import ledger
+
+    ledger.emit_event("instant", name, **attrs)
 
 
 def dump(trigger: str, path: Optional[str] = None) -> Optional[str]:

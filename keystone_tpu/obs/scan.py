@@ -39,6 +39,12 @@ def record_scan_span(stats):
     child span per lane on sharded scans). Returns the scan span so the
     pipeline can stamp late collective counts, or None when tracing is
     off (the usual single ``current() is None`` check)."""
+    # scan completion is an allocation peak (staged chunks + accumulator
+    # state all live): the memory-watermark seam samples here whether or
+    # not tracing is on
+    from . import resource as _resource
+
+    _resource.sample_memory()
     tracer = current()
     if tracer is None:
         return None

@@ -57,6 +57,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from ..autoscale.qos import DEFAULT_TENANT, normalize_priority
 from ..faults import ReplicaKilled
 from ..obs import flight as _flight
+from ..obs import resource as _resource
 from ..obs.tracer import current as _trace_current
 from ..workflow.pipeline import FittedPipeline
 from .batching import BucketPolicy
@@ -218,6 +219,10 @@ class ServingFleet:
         self._closed = False
         self._ran = False
         self._metrics.set_gauge("queue_depth", lambda: self._scheduler.depth)
+        # device-memory watermark gauges (live=sum, peak=max,
+        # fraction=mean across merged worker snapshots); no-op when
+        # KEYSTONE_ACCOUNTING is off
+        _resource.install_memory_gauges(self._metrics)
 
     # -- introspection ---------------------------------------------------
 

@@ -45,6 +45,15 @@ logger = logging.getLogger(__name__)
 #: quantiles reported by :meth:`MetricsRegistry.latency_quantiles`
 _QUANTILES = (0.50, 0.95, 0.99)
 
+#: how a gauge folds across process snapshots in :meth:`MetricsRegistry.merge`
+#: — additive quantities sum (queue depth, live bytes across distinct
+#: devices), watermarks take the max (peak memory), ratios average
+#: (utilization fractions: summing two 0.9s into 1.8 is fiction)
+GAUGE_MERGE_MODES = ("sum", "max", "mean")
+
+#: per-(tenant, priority) accumulator columns, in storage order
+_COST_FIELDS = ("device_s", "queue_s", "payload_bytes", "items")
+
 
 class MetricsRegistry:
     """Thread-safe counters + gauges + a bounded latency reservoir."""
@@ -59,6 +68,12 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = defaultdict(int)
         self._gauges: Dict[str, Callable[[], float]] = {}
+        self._gauge_modes: Dict[str, str] = {}
+        # (tenant, priority) -> [device_s, queue_s, payload_bytes, items]:
+        # the per-identity cost table every replica batch is split into
+        self._costs: Dict[tuple, list] = {}
+        # device_s/items cursor per tenant for timeline cost deltas
+        self._costs_prev: Dict[str, list] = {}
         self._latencies: deque = deque(maxlen=latency_window)
         self._queue_ages: deque = deque(maxlen=latency_window)
         # priority class -> bounded reservoir: the per-class latency the
@@ -82,11 +97,44 @@ class MetricsRegistry:
         with self._lock:
             self._counters[counter] += n
 
-    def set_gauge(self, name: str, read: Callable[[], float]) -> None:
+    def set_gauge(
+        self, name: str, read: Callable[[], float], merge: str = "sum"
+    ) -> None:
         """Register a live-value gauge (e.g. queue depth); ``read`` is
-        called at snapshot time."""
+        called at snapshot time. ``merge`` declares how the gauge folds
+        across process snapshots (see :data:`GAUGE_MERGE_MODES`): additive
+        quantities ``sum``, watermarks ``max``, ratios ``mean``."""
+        if merge not in GAUGE_MERGE_MODES:
+            raise ValueError(
+                f"gauge merge mode {merge!r} not in {GAUGE_MERGE_MODES}"
+            )
         with self._lock:
             self._gauges[name] = read
+            self._gauge_modes[name] = merge
+
+    def observe_cost(
+        self,
+        tenant: str,
+        priority: str = "normal",
+        device_s: float = 0.0,
+        queue_s: float = 0.0,
+        payload_bytes: int = 0,
+        items: int = 0,
+    ) -> None:
+        """Charge one batch share to a (tenant, priority) identity:
+        attributed device-seconds, queue-seconds waited before dispatch,
+        and payload bytes carried. Accumulates the per-tenant cost table
+        that ``snapshot()["costs"]`` exposes, :meth:`merge` folds
+        fleet-wide, and :meth:`sample_timeline` emits as windowed
+        ``device_s`` deltas for per-tenant spend budgeting."""
+        with self._lock:
+            row = self._costs.setdefault(
+                (str(tenant), str(priority)), [0.0, 0.0, 0, 0]
+            )
+            row[0] += float(device_s)
+            row[1] += float(queue_s)
+            row[2] += int(payload_bytes)
+            row[3] += int(items)
 
     def observe_latency(
         self, seconds: float, priority: Optional[str] = None
@@ -136,6 +184,21 @@ class MetricsRegistry:
     def count(self, counter: str) -> int:
         with self._lock:
             return self._counters[counter]
+
+    def cost_table(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """The cumulative cost table as ``{tenant: {priority: {device_s,
+        queue_s, payload_bytes, items}}}`` (seconds rounded to µs)."""
+        with self._lock:
+            rows = {key: list(row) for key, row in self._costs.items()}
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for (tenant, priority), row in sorted(rows.items()):
+            out.setdefault(tenant, {})[priority] = {
+                "device_s": round(row[0], 6),
+                "queue_s": round(row[1], 6),
+                "payload_bytes": int(row[2]),
+                "items": int(row[3]),
+            }
+        return out
 
     def latency_quantiles(self) -> Dict[str, float]:
         with self._lock:
@@ -196,6 +259,24 @@ class MetricsRegistry:
                 if v - prev.get(k, 0)
             }
             self._timeline_prev = counters
+            # per-tenant spend THIS window (device_s/items deltas summed
+            # across priorities) — what SloPolicy's tenant budget judges
+            tenant_totals: Dict[str, list] = {}
+            for (tenant, _prio), row in self._costs.items():
+                slot = tenant_totals.setdefault(tenant, [0.0, 0])
+                slot[0] += row[0]
+                slot[1] += row[3]
+            cost_deltas = {}
+            for tenant, (dev, n) in tenant_totals.items():
+                pdev, pn = self._costs_prev.get(tenant, (0.0, 0))
+                if dev - pdev > 1e-9 or n - pn:
+                    cost_deltas[tenant] = {
+                        "device_s": round(dev - pdev, 6),
+                        "items": n - pn,
+                    }
+            self._costs_prev = {
+                t: list(v) for t, v in tenant_totals.items()
+            }
         gauge_vals = {}
         for k, read in gauges:
             try:
@@ -213,6 +294,8 @@ class MetricsRegistry:
             "queue_age": self.queue_age_quantiles(),
             "occupancy": (items / capacity) if capacity else None,
         }
+        if cost_deltas:
+            row["costs"] = cost_deltas
         with self._lock:
             self._timeline.append(row)
         return row
@@ -234,6 +317,7 @@ class MetricsRegistry:
         with self._lock:
             counters = dict(self._counters)
             gauges = list(self._gauges.items())
+            gauge_modes = dict(self._gauge_modes)
             items, capacity = self._batch_items, self._batch_capacity
             replicas = {
                 idx: list(row) for idx, row in self._replica_batches.items()
@@ -254,6 +338,8 @@ class MetricsRegistry:
             "name": self.name,
             "counters": counters,
             "gauges": {k: read() for k, read in gauges},
+            "gauge_modes": gauge_modes,
+            "costs": self.cost_table(),
             "batch_occupancy": {
                 "items": items,
                 "capacity": capacity,
@@ -301,7 +387,10 @@ class MetricsRegistry:
         INFO line and ``snapshot()`` report: fleet-wide shed / queue-age
         / occupancy, not per-process shards."""
         counters: Dict[str, int] = defaultdict(int)
-        gauges: Dict[str, float] = defaultdict(float)
+        # gauge name -> list of observed values; folded per declared mode
+        gauge_vals: Dict[str, list] = defaultdict(list)
+        gauge_modes: Dict[str, str] = {}
+        costs: Dict[tuple, list] = {}
         items = capacity = 0
         replicas: Dict[str, object] = {}
         lats: list = []
@@ -326,9 +415,22 @@ class MetricsRegistry:
             label = str(snap.get("name") or i)
             for k, v in (snap.get("counters") or {}).items():
                 counters[k] += int(v)
+            modes = snap.get("gauge_modes") or {}
             for k, v in (snap.get("gauges") or {}).items():
                 if isinstance(v, (int, float)):
-                    gauges[k] += v
+                    gauge_vals[k].append(float(v))
+                    # first declared mode wins; undeclared gauges sum
+                    # (the historical behavior — correct for depths)
+                    gauge_modes.setdefault(k, modes.get(k, "sum"))
+            for tenant, prios in (snap.get("costs") or {}).items():
+                for priority, row in prios.items():
+                    slot = costs.setdefault(
+                        (str(tenant), str(priority)), [0.0, 0.0, 0, 0]
+                    )
+                    slot[0] += float(row.get("device_s") or 0.0)
+                    slot[1] += float(row.get("queue_s") or 0.0)
+                    slot[2] += int(row.get("payload_bytes") or 0)
+                    slot[3] += int(row.get("items") or 0)
             occ = snap.get("batch_occupancy") or {}
             items += int(occ.get("items") or 0)
             capacity += int(occ.get("capacity") or 0)
@@ -348,11 +450,30 @@ class MetricsRegistry:
             rows = snap.get("timeline")
             if rows:
                 timelines[label] = [dict(r) for r in rows]
+        gauges: Dict[str, float] = {}
+        for k, vals in gauge_vals.items():
+            mode = gauge_modes.get(k, "sum")
+            if mode == "max":
+                gauges[k] = max(vals)
+            elif mode == "mean":
+                gauges[k] = sum(vals) / len(vals)
+            else:
+                gauges[k] = sum(vals)
+        merged_costs: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for (tenant, priority), row in sorted(costs.items()):
+            merged_costs.setdefault(tenant, {})[priority] = {
+                "device_s": round(row[0], 6),
+                "queue_s": round(row[1], 6),
+                "payload_bytes": int(row[2]),
+                "items": int(row[3]),
+            }
         return {
             "name": name,
             "merged_from": len(list(snapshots)),
             "counters": dict(counters),
-            "gauges": dict(gauges),
+            "gauges": gauges,
+            "gauge_modes": gauge_modes,
+            "costs": merged_costs,
             "batch_occupancy": {
                 "items": items,
                 "capacity": capacity,

@@ -45,6 +45,7 @@ from ..faults import (
     is_transient,
 )
 from ..obs import flight as _flight
+from ..obs import resource as _resource
 from ..obs.span import Span
 from ..obs.tracer import current as _trace_current
 from ..utils import timing
@@ -587,6 +588,17 @@ class Replica:
             )
         self._metrics.inc("completed", len(valid))
         self._metrics.observe_batch(len(valid), bucket, replica=self.index)
+        if _resource.accounting_enabled():
+            # charge the batch to its members: measured device-seconds
+            # split across the coalesced requests, queue-seconds against
+            # the dispatch timestamp, payload bytes from the validated
+            # rows — keyed by each request's (tenant, priority) identity
+            for (tenant, priority), cost in _resource.split_batch_cost(
+                valid, self.last_exec_seconds, now, payloads=rows
+            ).items():
+                self._metrics.observe_cost(tenant, priority, **cost)
+            # batch seam of the device-memory watermark (throttled)
+            _resource.sample_memory()
 
         shadow = self._shadow
         if shadow is not None:

@@ -40,13 +40,19 @@ class SloBreach:
     observed: float
     budget: float
     ts: float
+    #: optional identity the breach attributes to (the overspending
+    #: tenant for ``tenant_device_s_budget``); "" for fleet-wide ones
+    detail: str = ""
 
     def as_attrs(self) -> Dict[str, object]:
-        return {
+        attrs: Dict[str, object] = {
             "objective": self.objective,
             "observed": round(self.observed, 6),
             "budget": self.budget,
         }
+        if self.detail:
+            attrs["detail"] = self.detail
+        return attrs
 
 
 @dataclass
@@ -80,6 +86,18 @@ class SloPolicy:
         Trainer-loop objectives over the ``staleness_s`` / ``drift_score``
         gauges the daemon exports: a model too old, or drifting past the
         monitor's threshold, is an SLO breach even when serving is fast.
+    tenant_device_s_budget:
+        Per-tenant spend ceiling: attributed device-seconds any single
+        tenant may burn within ONE sample window (the ``costs`` deltas
+        the timeline rows carry from the per-tenant cost tables). The
+        breach's ``detail`` names the overspending tenant — this is a
+        fairness/abuse objective, not a capacity one, so the autoscaler
+        does not scale up on it.
+    device_mem_budget_bytes:
+        Device-memory watermark ceiling over the ``device_mem_bytes``
+        gauge the resource plane samples on scan/fit/batch seams; like
+        the tenant budget, more workers do not shrink a per-process
+        footprint, so it warns without triggering scale-up.
     """
 
     p99_budget_s: Optional[float] = None
@@ -88,6 +106,8 @@ class SloPolicy:
     max_restart_burn: Optional[int] = None
     max_staleness_s: Optional[float] = None
     max_drift_score: Optional[float] = None
+    tenant_device_s_budget: Optional[float] = None
+    device_mem_budget_bytes: Optional[float] = None
 
     def evaluate(self, row: Dict[str, object]) -> List[SloBreach]:
         """Judge one ``sample_timeline`` row; returns the breaches (empty
@@ -144,6 +164,24 @@ class SloPolicy:
             drift = gauges.get("drift_score")
             if drift is not None and drift > self.max_drift_score:
                 breach("max_drift_score", drift, self.max_drift_score)
+        if self.tenant_device_s_budget is not None:
+            for tenant, cost in sorted(
+                (row.get("costs") or {}).items()
+            ):
+                spent = float((cost or {}).get("device_s") or 0.0)
+                if spent > self.tenant_device_s_budget:
+                    out.append(SloBreach(
+                        "tenant_device_s_budget", spent,
+                        float(self.tenant_device_s_budget), ts,
+                        detail=str(tenant),
+                    ))
+        if self.device_mem_budget_bytes is not None:
+            mem = gauges.get("device_mem_bytes")
+            if mem is not None and mem > self.device_mem_budget_bytes:
+                breach(
+                    "device_mem_budget_bytes", mem,
+                    self.device_mem_budget_bytes,
+                )
         return out
 
 

@@ -181,10 +181,15 @@ class TrainerDaemon:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._join_timeout_s = float(join_timeout_s)
+        # drift/staleness are watermark-shaped (the WORST process is the
+        # fleet's truth — summing two drift scores across a merge is
+        # fiction); backlog is additive
         self._metrics.set_gauge(
-            "drift_score", lambda: self._monitor.score()["drift_score"]
+            "drift_score",
+            lambda: self._monitor.score()["drift_score"],
+            merge="max",
         )
-        self._metrics.set_gauge("staleness_s", self.staleness_s)
+        self._metrics.set_gauge("staleness_s", self.staleness_s, merge="max")
         self._metrics.set_gauge(
             "trainer_backlog", lambda: len(self._source) - self._resolved
         )
@@ -498,6 +503,11 @@ class TrainerDaemon:
         )
         self._metrics.inc("absorbed_chunks", attempt.stop - attempt.start)
         self._metrics.inc("absorbed_rows", int(labels.shape[0]))
+        # fit seam of the device-memory watermark: absorb holds the
+        # candidate's full accumulator state — a footprint peak
+        from ..obs import resource as _resource
+
+        _resource.sample_memory()
         return candidate
 
     def _batch_failed(self, attempt: _Attempt, exc, *, phase: str) -> None:

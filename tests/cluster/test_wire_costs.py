@@ -1,0 +1,46 @@
+"""The cost-table wire codec (keystone_tpu/cluster/wire.py): compact
+pong-delta rows, zero-row suppression, and malformed-frame tolerance."""
+
+from keystone_tpu.cluster.wire import costs_from_wire, costs_to_wire
+
+
+def test_round_trip_preserves_the_charges():
+    table = {
+        "gold": {
+            "high": {"device_s": 0.25, "queue_s": 0.0625,
+                     "payload_bytes": 1024, "items": 3},
+        },
+        "bronze": {
+            "normal": {"device_s": 0.125, "queue_s": 0.0,
+                       "payload_bytes": 0, "items": 1},
+        },
+    }
+    rows = costs_from_wire(costs_to_wire(table))
+    assert sorted(r[:2] for r in rows) == [
+        ("bronze", "normal"), ("gold", "high"),
+    ]
+    by_key = {(t, p): c for t, p, c in rows}
+    assert by_key[("gold", "high")] == table["gold"]["high"]
+    assert by_key[("bronze", "normal")]["device_s"] == 0.125
+
+
+def test_all_zero_rows_and_empty_tables_ship_as_none():
+    assert costs_to_wire({}) is None
+    assert costs_to_wire(None) is None
+    assert costs_to_wire({
+        "idle": {"normal": {"device_s": 0.0, "queue_s": 0.0,
+                            "payload_bytes": 0, "items": 0}},
+    }) is None
+
+
+def test_malformed_payloads_decode_empty():
+    assert costs_from_wire(None) == []
+    assert costs_from_wire({"t": "not-a-dict"}) == []
+    assert costs_from_wire({"t": {"p": [0.1, 0.2]}}) == []  # short row
+    assert costs_from_wire({"t": {"p": ["x", 0, 0, 0]}}) == []
+    # one good row among garbage still decodes
+    rows = costs_from_wire({
+        "bad": {"p": None},
+        "good": {"normal": [0.5, 0.0, 10, 1]},
+    })
+    assert [r[0] for r in rows] == ["good"]

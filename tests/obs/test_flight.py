@@ -138,3 +138,31 @@ def test_global_dump_writes_through_module_helper(tmp_path, monkeypatch):
     path = dump("trainer_park")
     doc = json.loads(open(path).read())
     assert any(e["name"] == "trainer.park" for e in doc["entries"])
+
+
+def test_auto_named_dumps_are_retention_bounded(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("KEYSTONE_FLIGHT_KEEP", "3")
+    rec = FlightRecorder(ring=4)
+    rec.record_instant("x")
+    paths = [rec.dump(f"trigger{i}") for i in range(6)]
+    assert all(p is not None for p in paths)
+    kept = sorted(os.listdir(tmp_path))
+    assert len(kept) == 3
+    # the newest dumps survive; the oldest were pruned oldest-first
+    for p in paths[-3:]:
+        assert os.path.basename(p) in kept
+    for p in paths[:3]:
+        assert os.path.basename(p) not in kept
+
+
+def test_explicit_path_dumps_are_never_pruned(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("KEYSTONE_FLIGHT_KEEP", "2")
+    rec = FlightRecorder(ring=4)
+    rec.record_instant("x")
+    # a caller-chosen destination is an operator's deliberate artifact:
+    # retention only manages the auto-named files in the managed dir
+    for i in range(4):
+        rec.dump("kept", path=str(tmp_path / f"keystone-flight-op{i}.json"))
+    assert len(os.listdir(tmp_path)) == 4

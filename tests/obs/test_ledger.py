@@ -1,0 +1,166 @@
+"""NDJSON ledgers (keystone_tpu/obs/ledger.py): the never-raising sink,
+the compile ledger schema, and the KEYSTONE_EVENTS structured-event
+stream the flight recorder feeds."""
+
+import json
+import os
+
+import pytest
+
+from keystone_tpu.obs import ledger
+from keystone_tpu.obs.ledger import (
+    COMPILE_LEDGER_NAME,
+    CompileLedger,
+    NdjsonSink,
+    emit_event,
+    read_ndjson,
+    sink_for,
+)
+
+
+@pytest.fixture
+def events_env(tmp_path, monkeypatch):
+    """Point KEYSTONE_EVENTS at a tmp file for the test, restoring the
+    unresolved state afterwards so other tests see no sink."""
+    path = tmp_path / "events.ndjson"
+    monkeypatch.setenv("KEYSTONE_EVENTS", str(path))
+    ledger.reset_events()
+    yield path
+    ledger.reset_events()
+
+
+# -- the sink primitive ------------------------------------------------------
+
+
+def test_sink_round_trip_one_line_per_record(tmp_path):
+    sink = NdjsonSink(str(tmp_path / "a.ndjson"))
+    assert sink.append({"event": "x", "n": 1})
+    assert sink.append({"event": "y", "n": 2})
+    rows = read_ndjson(sink.path)
+    assert [r["event"] for r in rows] == ["x", "y"]
+    assert open(sink.path).read().count("\n") == 2
+
+
+def test_reader_skips_torn_lines(tmp_path):
+    path = tmp_path / "a.ndjson"
+    path.write_text('{"event":"ok"}\n{"event":"torn', encoding="utf-8")
+    rows = read_ndjson(str(path))
+    assert [r["event"] for r in rows] == ["ok"]
+
+
+def test_missing_file_reads_empty(tmp_path):
+    assert read_ndjson(str(tmp_path / "nope.ndjson")) == []
+
+
+def test_sink_disables_itself_on_write_failure(tmp_path):
+    # a directory path cannot be opened for append: the first failure
+    # disables the sink instead of raising (or re-warning per append)
+    sink = NdjsonSink(str(tmp_path))
+    assert sink.append({"event": "x"}) is False
+    assert sink._dead
+    assert sink.append({"event": "y"}) is False
+
+
+def test_unserializable_record_dropped_without_killing_sink(tmp_path):
+    sink = NdjsonSink(str(tmp_path / "a.ndjson"))
+    assert sink.append({"bad": object()}) is True  # default=str coerces
+    assert sink.append({"worse": {1j: "x"}}) is False  # unkeyable
+    assert sink.append({"event": "still-alive"}) is True
+
+
+def test_sink_for_shares_one_instance_per_path(tmp_path):
+    p = str(tmp_path / "shared.ndjson")
+    assert sink_for(p) is sink_for(p)
+
+
+# -- the compile ledger ------------------------------------------------------
+
+
+def test_compile_ledger_lives_in_the_cache_root(tmp_path):
+    led = CompileLedger.for_cache_root(str(tmp_path))
+    assert led.path == str(tmp_path / COMPILE_LEDGER_NAME)
+
+
+def test_record_stamps_envelope_rounds_floats_skips_none(tmp_path):
+    led = CompileLedger(str(tmp_path / "l.ndjson"))
+    assert led.record(
+        "trace", key="k1", seconds=0.123456789, label=None, nbytes=42
+    )
+    (row,) = led.entries()
+    assert row["event"] == "trace" and row["pid"] == os.getpid()
+    assert row["ts"] > 0
+    assert row["seconds"] == 0.123457
+    assert row["nbytes"] == 42
+    assert "label" not in row
+
+
+def test_entries_filter_by_event(tmp_path):
+    led = CompileLedger(str(tmp_path / "l.ndjson"))
+    led.record("trace", key="a")
+    led.record("load", key="a")
+    led.record("load", key="b")
+    assert [r["key"] for r in led.entries("load")] == ["a", "b"]
+    assert len(led.entries()) == 3
+
+
+def test_cache_store_hit_evict_land_in_the_ledger(tmp_path):
+    from keystone_tpu.compile.cache import ExecutableCache
+
+    cache = ExecutableCache(str(tmp_path), max_bytes=1 << 20)
+    cache.store("k1", b"x" * 64, {"env": {}})
+    assert cache.load("k1") is not None
+    events = [r["event"] for r in cache.ledger.entries()]
+    assert events == ["store", "hit"]
+    assert cache.ledger.entries("store")[0]["nbytes"] == 64
+
+
+# -- the events sink ---------------------------------------------------------
+
+
+def test_emit_event_without_env_is_a_noop(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_EVENTS", raising=False)
+    ledger.reset_events()
+    try:
+        assert emit_event("instant", "x.y", worker=1) is False
+    finally:
+        ledger.reset_events()
+
+
+def test_emit_event_writes_envelope_with_nested_attrs(events_env):
+    assert emit_event("instant", "scale.up", worker=3, skipped=None)
+    (row,) = read_ndjson(str(events_env))
+    assert row["event"] == "instant" and row["name"] == "scale.up"
+    assert row["attrs"] == {"worker": 3}
+    assert row["pid"] == os.getpid() and row["ts"] > 0
+
+
+def test_attr_names_cannot_shadow_the_envelope(events_env):
+    # regression: fleet restart instants carry kind=/name=-style attrs;
+    # they must nest rather than collide with emit_event's own params
+    assert emit_event("instant", "fault.replica_down", kind="transient",
+                      name="replica-0")
+    (row,) = read_ndjson(str(events_env))
+    assert row["event"] == "instant"
+    assert row["name"] == "fault.replica_down"
+    assert row["attrs"] == {"kind": "transient", "name": "replica-0"}
+
+
+def test_flight_instants_stream_into_the_events_sink(events_env):
+    from keystone_tpu.obs import flight
+
+    flight.record_instant("slo.breach", objective="p99_budget_s",
+                          kind="breach")
+    rows = [
+        r for r in read_ndjson(str(events_env))
+        if r.get("name") == "slo.breach"
+    ]
+    assert rows and rows[-1]["attrs"]["objective"] == "p99_budget_s"
+
+
+def test_events_sink_is_resolved_once(events_env, monkeypatch):
+    emit_event("instant", "first")
+    # changing the env mid-process does not silently retarget the stream
+    monkeypatch.setenv("KEYSTONE_EVENTS", "/nonexistent/other.ndjson")
+    emit_event("instant", "second")
+    names = [r["name"] for r in read_ndjson(str(events_env))]
+    assert names == ["first", "second"]

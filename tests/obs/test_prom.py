@@ -1,0 +1,247 @@
+"""Prometheus exposition (keystone_tpu/obs/prom.py): text-format
+validity, name/label escaping, counter monotonicity across scrapes, and
+the HTTP scrape server round-trip."""
+
+import re
+import threading
+import urllib.request
+
+from keystone_tpu.obs.prom import (
+    CONTENT_TYPE,
+    PrometheusExporter,
+    escape_label_value,
+    render_prometheus,
+    sanitize_metric_name,
+)
+from keystone_tpu.serving.metrics import MetricsRegistry
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"  # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\[\\\"n])*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\[\\\"n])*\")*\})?"
+    r" (-?[0-9.e+-]+|nan|inf)$"
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """Validate text-format 0.0.4 structurally and return
+    ``{sample_line_name{labels}: float}``. Every sample's family must
+    carry a preceding ``# TYPE`` line; any malformed line asserts."""
+    samples = {}
+    typed = set()
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4 and parts[3] in (
+                "counter", "gauge", "summary", "histogram", "untyped"
+            ), line
+            typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), line
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name = m.group(1)
+        base = name
+        for suffix in ("_count", "_sum", "_bucket"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+        assert base in typed, f"sample {name} has no # TYPE family"
+        key, value = line.rsplit(" ", 1)
+        samples[key] = float(value)
+    return samples
+
+
+def _loaded_registry() -> MetricsRegistry:
+    m = MetricsRegistry("w0")
+    m.inc("submitted", 10)
+    m.inc("completed", 9)
+    m.inc("shed.low", 2)
+    m.inc("tenant.served.acme", 4)
+    m.inc("slo_breach.p99_budget_s", 1)
+    m.set_gauge("queue_depth", lambda: 3.0)
+    m.observe_cost("acme", "high", device_s=0.5, queue_s=0.1,
+                   payload_bytes=2048, items=4)
+    m.observe_latency(0.01, priority="high")
+    m.observe_queue_age(0.002)
+    m.observe_batch(6, 8, replica=0)
+    return m
+
+
+# -- name / label hygiene ----------------------------------------------------
+
+
+def test_sanitize_metric_name():
+    assert sanitize_metric_name("tenant.served.acme") == "tenant_served_acme"
+    assert sanitize_metric_name("a-b c") == "a_b_c"
+    assert sanitize_metric_name("9lives") == "_9lives"
+    assert sanitize_metric_name("") == "_"
+    assert sanitize_metric_name("ok_name:x") == "ok_name:x"
+
+
+def test_escape_label_value():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    assert escape_label_value(7) == "7"
+
+
+def test_hostile_identity_values_render_validly():
+    m = MetricsRegistry("w0")
+    m.observe_cost('ten"ant\n\\evil', "nor mal", device_s=0.1, items=1)
+    text = render_prometheus(m.snapshot())
+    parse_exposition(text)  # asserts structural validity
+    assert '\\"' in text and "\\n" in text
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def test_counters_render_as_total_families_with_type_lines():
+    text = render_prometheus(_loaded_registry().snapshot())
+    samples = parse_exposition(text)
+    assert samples["keystone_submitted_total"] == 10
+    assert samples["keystone_completed_total"] == 9
+    assert "# TYPE keystone_submitted_total counter" in text
+    assert "# TYPE keystone_queue_depth gauge" in text
+
+
+def test_dotted_counters_become_labeled_families():
+    samples = parse_exposition(
+        render_prometheus(_loaded_registry().snapshot())
+    )
+    assert samples['keystone_shed_by_priority_total{priority="low"}'] == 2
+    assert samples['keystone_tenant_served_total{tenant="acme"}'] == 4
+    assert samples[
+        'keystone_slo_breach_total{objective="p99_budget_s"}'
+    ] == 1
+
+
+def test_cost_table_renders_four_labeled_families():
+    samples = parse_exposition(
+        render_prometheus(_loaded_registry().snapshot())
+    )
+    labels = '{tenant="acme",priority="high"}'
+    assert samples[f"keystone_tenant_device_seconds_total{labels}"] == 0.5
+    assert samples[f"keystone_tenant_queue_seconds_total{labels}"] == 0.1
+    assert samples[f"keystone_tenant_payload_bytes_total{labels}"] == 2048
+    assert samples[f"keystone_tenant_items_total{labels}"] == 4
+
+
+def test_summaries_carry_quantiles_count_and_sum():
+    text = render_prometheus(_loaded_registry().snapshot())
+    samples = parse_exposition(text)
+    assert "# TYPE keystone_latency_seconds summary" in text
+    assert samples['keystone_latency_seconds{quantile="0.99"}'] == 0.01
+    assert samples["keystone_latency_seconds_count"] == 1
+    assert samples[
+        'keystone_priority_latency_seconds{priority="high",quantile="0.5"}'
+    ] == 0.01
+
+
+def test_merged_snapshot_renders_with_merge_width():
+    m = _loaded_registry()
+    merged = MetricsRegistry.merge(
+        [m.snapshot(sketches=True), m.snapshot(sketches=True)]
+    )
+    samples = parse_exposition(render_prometheus(merged))
+    assert samples["keystone_merged_processes"] == 2
+    assert samples["keystone_submitted_total"] == 20
+
+
+def test_counters_are_monotone_across_scrapes():
+    m = _loaded_registry()
+    seen = []
+    for _ in range(5):
+        samples = parse_exposition(render_prometheus(m.snapshot()))
+        seen.append({
+            k: v for k, v in samples.items() if k.endswith("_total")
+        })
+        m.inc("submitted")
+        m.observe_cost("acme", "high", device_s=0.25, items=1)
+    for before, after in zip(seen, seen[1:]):
+        for key, value in before.items():
+            assert after.get(key, 0.0) >= value, key
+
+
+def test_scrape_under_concurrent_mutation_stays_valid():
+    m = _loaded_registry()
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            m.inc("submitted")
+            m.observe_cost(f"t{i % 3}", device_s=0.001, items=1)
+            m.observe_latency(0.001)
+            i += 1
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(20):
+            parse_exposition(render_prometheus(m.snapshot()))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+# -- the scrape server -------------------------------------------------------
+
+
+def test_http_exporter_round_trip():
+    m = _loaded_registry()
+    exporter = PrometheusExporter(lambda: m.snapshot(), port=0)
+    host, port = exporter.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == CONTENT_TYPE
+            body = resp.read().decode("utf-8")
+        samples = parse_exposition(body)
+        assert samples["keystone_submitted_total"] == 10
+        # starting twice is idempotent (same address back)
+        assert exporter.start() == (host, port)
+    finally:
+        exporter.stop()
+    assert exporter.address is None
+
+
+def test_http_exporter_404_off_path_and_500_on_snapshot_failure():
+    import urllib.error
+
+    good = MetricsRegistry("w0")
+    exporter = PrometheusExporter(good.snapshot, port=0)
+    host, port = exporter.start()
+    try:
+        try:
+            urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        exporter.stop()
+
+    def broken():
+        raise RuntimeError("stats hub down")
+
+    exporter = PrometheusExporter(broken, port=0)
+    host, port = exporter.start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5
+            )
+            assert False, "expected 500"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+    finally:
+        exporter.stop()

@@ -1,0 +1,132 @@
+"""Resource accounting (keystone_tpu/obs/resource.py): the equal-split
+attribution arithmetic, the KEYSTONE_ACCOUNTING gate, and the memory
+watermark's throttle/merge-mode contract."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from keystone_tpu.obs import resource
+from keystone_tpu.serving.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_gate():
+    resource.reset()
+    yield
+    resource.reset()
+
+
+def _req(tenant=None, priority=None, enqueued=None, nbytes=0):
+    datum = SimpleNamespace(nbytes=nbytes) if nbytes else None
+    return SimpleNamespace(
+        tenant=tenant, priority=priority, enqueued=enqueued, datum=datum
+    )
+
+
+# -- attribution -------------------------------------------------------------
+
+
+def test_split_is_equal_and_sums_reconstruct_device_seconds():
+    reqs = [
+        _req("gold", "high", enqueued=9.0),
+        _req("gold", "high", enqueued=9.5),
+        _req("bronze", enqueued=8.0),
+    ]
+    table = resource.split_batch_cost(reqs, device_seconds=0.3, now=10.0)
+    gold = table[("gold", "high")]
+    bronze = table[("bronze", "normal")]
+    assert gold["device_s"] == pytest.approx(0.2)
+    assert bronze["device_s"] == pytest.approx(0.1)
+    assert gold["items"] == 2 and bronze["items"] == 1
+    total = sum(row["device_s"] for row in table.values())
+    assert total == pytest.approx(0.3)
+    # queue seconds are per-member waits, summed per identity
+    assert gold["queue_s"] == pytest.approx(1.0 + 0.5)
+    assert bronze["queue_s"] == pytest.approx(2.0)
+
+
+def test_missing_identity_defaults_and_clamped_queue_wait():
+    table = resource.split_batch_cost(
+        [_req(enqueued=99.0)], device_seconds=0.05, now=10.0
+    )
+    ((key, row),) = table.items()
+    assert key == ("default", "normal")
+    assert row["queue_s"] == 0.0  # clock skew never charges negative wait
+
+
+def test_payload_bytes_prefer_validated_rows():
+    reqs = [_req("t", nbytes=100), _req("t", nbytes=100)]
+    payloads = [SimpleNamespace(nbytes=64), SimpleNamespace(nbytes=32)]
+    table = resource.split_batch_cost(
+        reqs, device_seconds=0.0, now=0.0, payloads=payloads
+    )
+    assert table[("t", "normal")]["payload_bytes"] == 96
+
+
+def test_payload_bytes_fall_back_to_the_datum():
+    table = resource.split_batch_cost(
+        [_req("t", nbytes=128)], device_seconds=0.0, now=0.0
+    )
+    assert table[("t", "normal")]["payload_bytes"] == 128
+    assert resource.payload_nbytes(b"abcd") == 4
+    assert resource.payload_nbytes(None) == 0
+
+
+def test_empty_batch_charges_nothing():
+    assert resource.split_batch_cost([], 1.0, 0.0) == {}
+
+
+# -- the gate ----------------------------------------------------------------
+
+
+def test_accounting_gate_defaults_on(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_ACCOUNTING", raising=False)
+    resource.reset()
+    assert resource.accounting_enabled() is True
+
+
+def test_accounting_off_disables_sampling_and_gauges(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_ACCOUNTING", "0")
+    resource.reset()
+    assert resource.accounting_enabled() is False
+    assert resource.sample_memory() == 0
+    m = MetricsRegistry("w0")
+    resource.install_memory_gauges(m)
+    assert "device_mem_bytes" not in m.snapshot()["gauges"]
+
+
+# -- the watermark -----------------------------------------------------------
+
+
+def test_watermark_tracks_peak_and_throttles(monkeypatch):
+    wm = resource.MemoryWatermark()
+    monkeypatch.setattr(resource, "device_memory_bytes", lambda: (100, 1000))
+    assert wm.sample() == 100
+    assert wm.peak == 100 and wm.fraction() == pytest.approx(0.1)
+    monkeypatch.setattr(resource, "device_memory_bytes", lambda: (40, 1000))
+    # inside the throttle window the stale reading is returned
+    assert wm.sample(min_interval_s=3600.0) == 100
+    assert wm.sample() == 40
+    assert wm.peak == 100  # the high-water mark survives the drop
+
+
+def test_fraction_unknown_without_a_limit(monkeypatch):
+    wm = resource.MemoryWatermark()
+    monkeypatch.setattr(resource, "device_memory_bytes", lambda: (100, 0))
+    wm.sample()
+    assert wm.fraction() is None
+
+
+def test_device_memory_bytes_never_raises():
+    live, limit = resource.device_memory_bytes()
+    assert live >= 0 and limit >= 0
+
+
+def test_install_memory_gauges_declares_honest_merge_modes():
+    m = MetricsRegistry("w0")
+    resource.install_memory_gauges(m)
+    modes = m.snapshot()["gauge_modes"]
+    assert modes["device_mem_bytes"] == "sum"
+    assert modes["device_mem_peak_bytes"] == "max"
+    assert modes["device_mem_fraction"] == "mean"

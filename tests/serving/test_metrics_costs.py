@@ -1,0 +1,87 @@
+"""Cost tables + gauge merge modes (keystone_tpu/serving/metrics.py):
+per-(tenant, priority) accumulation, fleet-wide merge, the timeline's
+windowed spend deltas, and the declared gauge fold semantics."""
+
+import pytest
+
+from keystone_tpu.serving.metrics import GAUGE_MERGE_MODES, MetricsRegistry
+
+
+def test_observe_cost_accumulates_per_identity():
+    m = MetricsRegistry("w0")
+    m.observe_cost("gold", "high", device_s=0.2, queue_s=0.05,
+                   payload_bytes=100, items=2)
+    m.observe_cost("gold", "high", device_s=0.1, items=1)
+    m.observe_cost("gold", "low", device_s=0.3, items=1)
+    m.observe_cost("bronze", device_s=0.4, items=4)
+    table = m.cost_table()
+    assert table["gold"]["high"] == {
+        "device_s": pytest.approx(0.3), "queue_s": pytest.approx(0.05),
+        "payload_bytes": 100, "items": 3,
+    }
+    assert table["gold"]["low"]["device_s"] == pytest.approx(0.3)
+    assert table["bronze"]["normal"]["items"] == 4
+    assert m.snapshot()["costs"] == table
+
+
+def test_merge_folds_cost_tables_across_workers():
+    a, b = MetricsRegistry("w0"), MetricsRegistry("w1")
+    a.observe_cost("gold", "high", device_s=0.2, payload_bytes=10, items=1)
+    b.observe_cost("gold", "high", device_s=0.3, payload_bytes=20, items=2)
+    b.observe_cost("bronze", device_s=0.1, items=1)
+    merged = MetricsRegistry.merge([a.snapshot(), b.snapshot()])
+    assert merged["costs"]["gold"]["high"] == {
+        "device_s": pytest.approx(0.5), "queue_s": 0.0,
+        "payload_bytes": 30, "items": 3,
+    }
+    assert merged["costs"]["bronze"]["normal"]["items"] == 1
+
+
+def test_timeline_rows_carry_windowed_spend_deltas():
+    m = MetricsRegistry("w0")
+    m.observe_cost("gold", "high", device_s=0.2, items=2)
+    m.observe_cost("gold", "low", device_s=0.1, items=1)
+    row1 = m.sample_timeline(now=1.0)
+    # deltas sum across priorities: the tenant budget judges the tenant
+    assert row1["costs"]["gold"] == {
+        "device_s": pytest.approx(0.3), "items": 3,
+    }
+    row2 = m.sample_timeline(now=2.0)
+    assert "costs" not in row2  # quiet window: no spend, no key
+    m.observe_cost("gold", "high", device_s=0.05, items=1)
+    row3 = m.sample_timeline(now=3.0)
+    assert row3["costs"]["gold"]["device_s"] == pytest.approx(0.05)
+
+
+def test_set_gauge_rejects_unknown_merge_mode():
+    m = MetricsRegistry("w0")
+    with pytest.raises(ValueError):
+        m.set_gauge("x", lambda: 0.0, merge="median")
+    assert set(GAUGE_MERGE_MODES) == {"sum", "max", "mean"}
+
+
+def test_gauges_fold_by_declared_mode():
+    a, b = MetricsRegistry("w0"), MetricsRegistry("w1")
+    for m, depth, peak, frac in ((a, 3.0, 100.0, 0.2), (b, 5.0, 80.0, 0.6)):
+        m.set_gauge("queue_depth", lambda v=depth: v)  # default: sum
+        m.set_gauge("peak_bytes", lambda v=peak: v, merge="max")
+        m.set_gauge("mem_fraction", lambda v=frac: v, merge="mean")
+    merged = MetricsRegistry.merge([a.snapshot(), b.snapshot()])
+    assert merged["gauges"]["queue_depth"] == 8.0
+    assert merged["gauges"]["peak_bytes"] == 100.0
+    assert merged["gauges"]["mem_fraction"] == pytest.approx(0.4)
+    # the modes survive the merge so a re-merge (router of routers)
+    # folds identically
+    assert merged["gauge_modes"]["peak_bytes"] == "max"
+
+
+def test_undeclared_gauges_keep_the_historical_sum():
+    # a pre-merge-mode worker snapshot (no gauge_modes key) still sums
+    a = MetricsRegistry("w0")
+    a.set_gauge("queue_depth", lambda: 2.0)
+    snap_a = a.snapshot()
+    del snap_a["gauge_modes"]
+    b = MetricsRegistry("w1")
+    b.set_gauge("queue_depth", lambda: 3.0)
+    merged = MetricsRegistry.merge([snap_a, b.snapshot()])
+    assert merged["gauges"]["queue_depth"] == 5.0

@@ -1,0 +1,99 @@
+"""The resource-budget SLO objectives (keystone_tpu/serving/slo.py):
+per-tenant device-second spend and the device-memory watermark — and the
+autoscaler's refusal to treat either as capacity evidence."""
+
+from keystone_tpu.serving.slo import SloBreach, SloPolicy
+
+
+def _row(**over):
+    row = {"ts": 100.0, "counters": {}, "gauges": {}}
+    row.update(over)
+    return row
+
+
+def test_tenant_budget_breach_names_the_overspender():
+    policy = SloPolicy(tenant_device_s_budget=0.25)
+    breaches = policy.evaluate(_row(costs={
+        "gold": {"device_s": 0.4, "items": 7},
+        "bronze": {"device_s": 0.1, "items": 2},
+    }))
+    (b,) = breaches
+    assert b.objective == "tenant_device_s_budget"
+    assert b.detail == "gold" and b.observed == 0.4 and b.budget == 0.25
+    assert b.as_attrs()["detail"] == "gold"
+
+
+def test_each_overspending_tenant_breaches_separately():
+    policy = SloPolicy(tenant_device_s_budget=0.05)
+    breaches = policy.evaluate(_row(costs={
+        "a": {"device_s": 0.1}, "b": {"device_s": 0.2},
+    }))
+    assert sorted(b.detail for b in breaches) == ["a", "b"]
+
+
+def test_rows_without_costs_never_breach_the_tenant_budget():
+    policy = SloPolicy(tenant_device_s_budget=0.0)
+    assert policy.evaluate(_row()) == []
+
+
+def test_device_mem_budget_judges_the_watermark_gauge():
+    policy = SloPolicy(device_mem_budget_bytes=1000)
+    (b,) = policy.evaluate(_row(gauges={"device_mem_bytes": 2048.0}))
+    assert b.objective == "device_mem_budget_bytes"
+    assert b.observed == 2048.0
+    assert policy.evaluate(_row(gauges={"device_mem_bytes": 512.0})) == []
+    # no reading (accounting off / gauge absent): not judged
+    assert policy.evaluate(_row()) == []
+
+
+def test_fleet_wide_breaches_carry_no_detail():
+    b = SloBreach("p99_budget_s", 0.5, 0.1, 100.0)
+    assert b.detail == "" and "detail" not in b.as_attrs()
+
+
+def test_resource_breaches_never_buy_scale_ups():
+    from keystone_tpu.autoscale.policy import ScalePolicy
+    from keystone_tpu.autoscale.scaler import (
+        NON_CAPACITY_OBJECTIVES,
+        Autoscaler,
+    )
+
+    assert NON_CAPACITY_OBJECTIVES == {
+        "tenant_device_s_budget", "device_mem_budget_bytes",
+    }
+
+    class Actuator:
+        service_estimate = 0.01
+
+        def scale_view(self):
+            return {"admitting": 1, "booting": 0, "draining": 0}
+
+        def __init__(self):
+            self.spawns = 0
+
+        def scale_up_slot(self):
+            self.spawns += 1
+            raise RuntimeError("spawn refused (stub)")
+
+        def pick_drain_candidate(self):
+            return None
+
+        def reap_slot(self, index):
+            pass
+
+    policy = ScalePolicy(min_workers=1, max_workers=4, up_breaches=1,
+                         up_cooldown_s=0.0)
+    actuator = Actuator()
+    scaler = Autoscaler(policy, actuator)
+    breaches = [
+        SloBreach("tenant_device_s_budget", 9.0, 1.0, 100.0, detail="gold"),
+        SloBreach("device_mem_budget_bytes", 2e9, 1e9, 100.0),
+    ]
+    assert scaler.tick(breaches=breaches, row=_row()) == []
+    assert len(scaler._breach_window) == 0
+    assert actuator.spawns == 0
+    # ...while a capacity breach with the same plumbing DOES try to spawn
+    capacity = [SloBreach("queue_age_p99_budget_s", 0.9, 0.1, 100.0)]
+    decisions = scaler.tick(breaches=capacity, row=_row())
+    assert actuator.spawns == 1
+    assert [d.reason for d in decisions] == ["breach"]

@@ -318,6 +318,104 @@ def test_trees_without_the_contract_files_skip_rule4(tmp_path):
     ] == []
 
 
+# ---------------------------------------------------------------------------
+# rule 5: counter coverage
+# ---------------------------------------------------------------------------
+
+
+def _counter_tree(tmp_path, *, known, rendered, inc_lines):
+    """A minimal keystone_tpu-shaped tree for rule 5: a KNOWN_COUNTERS
+    tuple in obs/prom.py, a format_status reading ``rendered`` names in
+    cluster/router.py, and ``inc_lines`` of increment-site source."""
+    root = tmp_path / "keystone_tpu"
+    (root / "obs").mkdir(parents=True)
+    (root / "cluster").mkdir()
+    (root / "obs" / "prom.py").write_text(
+        "KNOWN_COUNTERS = (\n"
+        + "".join(f"    {n!r},\n" for n in known)
+        + ")\n"
+    )
+    reads = "".join(f"    x += c.get({n!r}, 0)\n" for n in rendered)
+    (root / "cluster" / "router.py").write_text(
+        "def format_status(status):\n"
+        "    c = status['counters']\n"
+        "    x = 0\n" + reads + "    return str(x)\n"
+    )
+    (root / "sites.py").write_text(
+        "def work(metrics, self_counters, who):\n"
+        + "".join(f"    {line}\n" for line in inc_lines)
+        or "    pass\n"
+    )
+    return str(root)
+
+
+def _coverage(root):
+    return [v for v in lint_tree(root) if v.rule == "counter-coverage"]
+
+
+def test_known_counter_without_inc_site_flagged(tmp_path):
+    root = _counter_tree(
+        tmp_path, known=["submitted", "ghost"], rendered=[],
+        inc_lines=['metrics.inc("submitted")'],
+    )
+    vs = _coverage(root)
+    assert len(vs) == 1 and "'ghost'" in vs[0].message
+    assert vs[0].path.endswith("prom.py")
+
+
+def test_rendered_counter_without_inc_site_flagged(tmp_path):
+    root = _counter_tree(
+        tmp_path, known=[], rendered=["restarts"], inc_lines=[],
+    )
+    vs = _coverage(root)
+    assert len(vs) == 1 and "'restarts'" in vs[0].message
+    assert vs[0].path.endswith("router.py")
+
+
+def test_dotted_family_covered_by_fstring_prefix(tmp_path):
+    root = _counter_tree(
+        tmp_path, known=["shed.", "tenant.served."], rendered=[],
+        inc_lines=[
+            'metrics.inc(f"shed.{who}")',
+            'metrics.inc(f"tenant.served.{who}")',
+        ],
+    )
+    assert _coverage(root) == []
+
+
+def test_dotted_family_not_covered_by_exact_literal(tmp_path):
+    # the family promises per-identity series; a literal "shed." inc
+    # (no identity appended) doesn't produce them
+    root = _counter_tree(
+        tmp_path, known=["shed."], rendered=[],
+        inc_lines=['metrics.inc("shed.")'],
+    )
+    vs = _coverage(root)
+    assert len(vs) == 1 and "'shed.'" in vs[0].message
+
+
+def test_augassign_counter_site_counts(tmp_path):
+    # MetricsRegistry increments "batches" via _counters["batches"] += 1
+    root = _counter_tree(
+        tmp_path, known=["batches"], rendered=[],
+        inc_lines=['self_counters["batches"] += 1'],
+    )
+    assert _coverage(root) == []
+
+
+def test_rendered_counter_judged_once_when_also_known(tmp_path):
+    root = _counter_tree(
+        tmp_path, known=["completed"], rendered=["completed"], inc_lines=[],
+    )
+    vs = _coverage(root)
+    assert len(vs) == 1 and vs[0].path.endswith("prom.py")
+
+
+def test_trees_without_the_export_plane_skip_rule5(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    assert _coverage(str(tmp_path)) == []
+
+
 def test_violation_str_carries_location(tmp_path):
     vs = _lint_source(tmp_path, """
         try:

@@ -34,6 +34,15 @@ to fix by review more than once, plus the env-knob routing rule:
    handling path emits somewhere in the tree — a chaos seam whose
    failure leaves no flight-recorder/trace evidence is flagged.
 
+5. **Every exported counter is actually incremented.** Each counter
+   name the exposition plane documents (``obs/prom.py::KNOWN_COUNTERS``;
+   a trailing ``.`` marks a dotted per-identity family matched as an
+   f-string prefix) and each counter ``cluster/router.py::format_status``
+   renders must have an increment site somewhere under the tree — an
+   ``inc("name")`` / ``inc(f"name.{...}")`` call or a
+   ``..._counters["name"] += n`` augmented assignment. A scrape target or
+   status line that can only ever read 0 is a dashboard lie.
+
 Run as a script (``python tools/lint_invariants.py [root]``, exits 1 on
 violations) or via :func:`lint_tree` (the tier-1 test in
 ``tests/test_lint_invariants.py`` does the latter, so CI enforces all of
@@ -414,6 +423,167 @@ def _check_fault_observability(root: str) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# rule 5: counter coverage
+# ---------------------------------------------------------------------------
+#
+# The set of counters the observability plane PROMISES — obs/prom.py's
+# KNOWN_COUNTERS tuple (the exposition families) plus every counter
+# cluster/router.py::format_status reads off the merged snapshot — must
+# each be produced by a real increment site under the tree. Counters are
+# incremented two ways in this codebase: MetricsRegistry.inc("name") /
+# inc(f"name.{identity}") calls, and direct `..._counters["name"] += n`
+# augmented assignments inside the registry itself.
+
+
+def _known_counters(prom_path: str) -> List[Tuple[str, int]]:
+    """``(name, lineno)`` per element of the module-level KNOWN_COUNTERS
+    string tuple/list in obs/prom.py (order preserved)."""
+    with open(prom_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=prom_path)
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if not (
+            isinstance(target, ast.Name) and target.id == "KNOWN_COUNTERS"
+        ):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return [
+                (e.value, e.lineno)
+                for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+    return []
+
+
+def _rendered_counters(router_path: str) -> List[Tuple[str, int]]:
+    """Counters ``format_status`` reads as ``c.get("name", ...)`` —
+    the receiver name is pinned to ``c`` (the merged-counters local) so
+    unrelated dict lookups in the same function never count."""
+    with open(router_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=router_path)
+    out: List[Tuple[str, int]] = []
+    for fn in ast.walk(tree):
+        if not (
+            isinstance(fn, ast.FunctionDef) and fn.name == "format_status"
+        ):
+            continue
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "c"
+            ):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) and (
+                isinstance(node.args[0].value, str)
+            ):
+                out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def _counter_inc_sites(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """``(exact, prefixes)`` increment sites in one module: exact names
+    from ``inc("name")`` string literals and ``..._counters["name"] += n``
+    augmented assignments; dotted-family prefixes from the leading
+    constant of ``inc(f"name.{identity}")`` f-strings."""
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            leaf = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else ""
+            )
+            if leaf != "inc" or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                exact.add(arg.value)
+            elif (
+                isinstance(arg, ast.JoinedStr)
+                and arg.values
+                and isinstance(arg.values[0], ast.Constant)
+                and isinstance(arg.values[0].value, str)
+            ):
+                prefixes.add(arg.values[0].value)
+        elif isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            target = node.target
+            if not isinstance(target, ast.Subscript):
+                continue
+            recv = target.value
+            recv_name = (
+                recv.attr if isinstance(recv, ast.Attribute)
+                else recv.id if isinstance(recv, ast.Name) else ""
+            )
+            if "counters" not in recv_name:
+                continue
+            key = target.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                exact.add(key.value)
+    return exact, prefixes
+
+
+def _check_counter_coverage(root: str) -> List[Violation]:
+    prom_path = os.path.join(root, "obs", "prom.py")
+    router_path = os.path.join(root, "cluster", "router.py")
+    if not (os.path.exists(prom_path) and os.path.exists(router_path)):
+        return []  # not the keystone_tpu package root (unit-test trees)
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue  # rule "syntax" already reports it
+            e, p = _counter_inc_sites(tree)
+            exact |= e
+            prefixes |= p
+
+    def covered(name: str) -> bool:
+        if name.endswith("."):
+            # a dotted per-identity family: any f-string increment whose
+            # constant head starts with the family prefix produces it
+            return any(p.startswith(name) for p in prefixes)
+        return name in exact
+
+    out: List[Violation] = []
+    known = _known_counters(prom_path)
+    for name, lineno in known:
+        if not covered(name):
+            out.append(Violation(
+                prom_path, lineno, "counter-coverage",
+                f"KNOWN_COUNTERS entry {name!r} has no increment site "
+                "under the tree (no inc() literal/f-string or "
+                "_counters[...] += assignment produces it) — the scrape "
+                "family can only ever read 0",
+            ))
+    seen = {name for name, _ in known}
+    for name, lineno in _rendered_counters(router_path):
+        if name in seen:
+            continue  # already judged under its KNOWN_COUNTERS entry
+        seen.add(name)
+        if not covered(name):
+            out.append(Violation(
+                router_path, lineno, "counter-coverage",
+                f"format_status renders counter {name!r} but no increment "
+                "site under the tree produces it — the status line can "
+                "only ever read 0",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -470,6 +640,7 @@ def lint_tree(root: str) -> List[Violation]:
             rel = os.path.relpath(path, base)
             violations.extend(lint_file(path, rel))
     violations.extend(_check_fault_observability(root))
+    violations.extend(_check_counter_coverage(root))
     violations.sort(key=lambda v: (v.path, v.line))
     return violations
 
