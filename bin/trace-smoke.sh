@@ -27,6 +27,11 @@
 # asserts the `sweep.*` spans (one grid_solve for the shared Gram group),
 # prefix memo-hit events for members 2..G, the `pipeline.absorb` span, and a
 # `serve.swap` span with zero dropped in-flight requests.
+# A tenth stage (segment compilation) fits + applies against a fresh AOT
+# cache three times: the cold run must trace `exec.segment` spans with
+# `aot.export`, the warm run must trace `aot.load` and ZERO `aot.export`,
+# and a kill-switched (`KEYSTONE_SEGMENT_COMPILE=0`) run must dispatch
+# strictly MORE node spans than the segment runs did.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
@@ -174,7 +179,12 @@ else:
     assert "aot.load" in names, names
     assert "aot.export" not in names, names
     assert fitted.compile_count == 0, fitted.compiled_signatures
-args = [e for e in doc["traceEvents"] if e["name"].startswith("aot.")][0]["args"]
+# segment dispatchers share the cache and emit aot.* spans during fit;
+# pick the whole-pipeline apply span (the one carrying the input shape)
+args = [
+    e for e in doc["traceEvents"]
+    if e["name"].startswith("aot.") and "shape" in e["args"]
+][0]["args"]
 # the exporter stringifies non-scalar attrs
 assert args.get("key") and str(args.get("shape")) == "[8, 784]", args
 print(f"AOT SPANS OK ({mode}): "
@@ -476,10 +486,19 @@ r = ClusterRouter(
 data = np.random.RandomState(0).randn(8, 32).astype(np.float32)
 with r:
     r.predict(data[0], timeout=30.0)  # THE traced request
-    path = r.export_trace(sys.argv[1])
-
-    with open(path) as f:
-        doc = json.load(f)
+    # worker spans ship on stats round-trips: cluster.handle ends when
+    # the reply is SENT, so it rides a LATER reply than the request's.
+    # collect_trace accumulates — poll until the hop tree is complete.
+    deadline = time.monotonic() + 30
+    while True:
+        path = r.export_trace(sys.argv[1])
+        with open(path) as f:
+            doc = json.load(f)
+        shipped = {e["name"] for e in doc["traceEvents"]}
+        if {"cluster.handle", "serve.replica"} <= shipped:
+            break
+        assert time.monotonic() < deadline, sorted(shipped)
+        time.sleep(0.2)
     ev = doc["traceEvents"]
     procs = {e["pid"]: e["args"]["name"] for e in ev
              if e["name"] == "process_name"}
@@ -538,4 +557,89 @@ with r:
         f"kill_instants={len(kills)} span_summaries={len(spans)} "
         f"-> {sorted(dumps)[-1]}"
     )
+PY
+
+# -- segment-compiled execution ----------------------------------------------
+seg_dir="$(mktemp -d /tmp/keystone-seg-smoke-XXXXXX)"
+trap 'rm -rf "$aot_dir" "$prof_dir" "$flight_dir" "$seg_dir"' EXIT
+for mode in cold warm nodes; do
+  seg_out="$(mktemp /tmp/keystone-seg-trace-XXXXXX.json)"
+  seg_flag=1
+  [ "$mode" = nodes ] && seg_flag=0
+  env JAX_PLATFORMS=cpu KEYSTONE_TRACE="$seg_out" \
+    KEYSTONE_AOT_CACHE="$seg_dir" KEYSTONE_SEGMENT_COMPILE="$seg_flag" \
+    python - "$seg_out" "$mode" "$seg_dir" <<'PY'
+import json
+import os
+import sys
+
+import numpy as np
+
+from keystone_tpu.utils.obs import configure, export_trace
+
+configure()
+
+from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.pipelines.mnist_random_fft import (
+    NUM_CLASSES,
+    MnistRandomFFTConfig,
+    build_featurizer,
+    synthetic_mnist,
+)
+
+train, test = synthetic_mnist(n_train=256, n_test=64, seed=7)
+conf = MnistRandomFFTConfig(num_ffts=2, block_size=512, lam=10.0)
+labels = ClassLabelIndicators(NUM_CLASSES).apply_batch(train.labels)
+pipeline = build_featurizer(conf).and_then(
+    BlockLeastSquaresEstimator(conf.block_size, 1, conf.lam or 0.0),
+    train.data, labels,
+).and_then(MaxClassifier())
+fitted = pipeline.fit()
+out = np.asarray(fitted.apply(test.data).to_array())
+np.save(os.path.join(sys.argv[3], f"out_{sys.argv[2]}.npy"), out)
+
+path = export_trace()
+assert path == sys.argv[1], (path, sys.argv[1])
+with open(path) as f:
+    doc = json.load(f)
+ev = doc["traceEvents"]
+names = [e["name"] for e in ev]
+segs = [e for e in ev if e["name"] == "exec.segment"]
+node_dispatches = sum(
+    1 for e in ev if e.get("ph") == "X" and e["name"].startswith("node.")
+)
+mode = sys.argv[2]
+if mode == "cold":
+    assert segs, "no exec.segment spans in the cold segment run"
+    assert any(int(e["args"]["nodes"]) >= 2 for e in segs), segs
+    assert "aot.export" in names, "cold segment run exported nothing"
+elif mode == "warm":
+    assert segs, "no exec.segment spans in the warm segment run"
+    assert "aot.load" in names, "warm segment run loaded nothing"
+    assert "aot.export" not in names, "warm segment run re-exported"
+else:
+    assert not segs, "kill-switched run still dispatched segments"
+# persist the per-mode dispatch count for the cross-run comparison
+with open(os.path.join(sys.argv[3], f"dispatches_{mode}"), "w") as f:
+    f.write(str(node_dispatches))
+print(f"SEGMENT SPANS OK ({mode}): {len(segs)} exec.segment span(s), "
+      f"{node_dispatches} node dispatch span(s)")
+PY
+done
+python - "$seg_dir" <<'PY'
+import sys
+
+import numpy as np
+
+d = sys.argv[1]
+counts = {m: int(open(f"{d}/dispatches_{m}").read()) for m in ("cold", "warm", "nodes")}
+# segment dispatch must collapse node spans vs the kill-switched run
+assert counts["cold"] < counts["nodes"], counts
+assert counts["warm"] < counts["nodes"], counts
+outs = {m: np.load(f"{d}/out_{m}.npy") for m in ("cold", "warm", "nodes")}
+assert np.array_equal(outs["cold"], outs["nodes"]), "segment vs node outputs differ"
+assert np.array_equal(outs["cold"], outs["warm"]), "cold vs warm outputs differ"
+print(f"SEGMENT DISPATCH OK: node spans {counts['nodes']} (node dispatch) -> "
+      f"{counts['cold']} (cold) / {counts['warm']} (warm), outputs bit-equal")
 PY

@@ -43,7 +43,12 @@ class Segment:
 
     index: int
     nodes: List[Any] = field(default_factory=list)  # topo order
-    #: external inputs (barrier nodes / sources) this segment reads
+    #: external inputs (barrier nodes / sources) this segment reads, in
+    #: topological (linearization) order of the producing node — a PINNED
+    #: contract: segment fingerprints and lowered-function signatures are
+    #: positional over this list, so the order must be stable across
+    #: processes (insertion order over members was not, since member
+    #: iteration depends on union-find grouping)
     inputs: List[Any] = field(default_factory=list)
     #: nodes whose value leaves the segment (consumed outside / by a sink)
     outputs: List[Any] = field(default_factory=list)
@@ -115,8 +120,13 @@ def plan_segments(
     from ..workflow import analysis
     from ..workflow.graph import NodeId
 
+    full_order = list(analysis.linearize(graph))
+    #: covers sources too — segment inputs may be SourceIds and their
+    #: ordering contract (see :class:`Segment`) needs a position for every
+    #: graph id a member can depend on
+    full_pos = {gid: i for i, gid in enumerate(full_order)}
     order = [
-        n for n in analysis.linearize(graph)
+        n for n in full_order
         if isinstance(n, NodeId) and n in graph.operators
     ]
     barriers: Dict[Any, str] = {}
@@ -170,11 +180,16 @@ def plan_segments(
         sorted(groups.values(), key=lambda ms: topo_pos[ms[0]])
     ):
         mset = set(members)
+        seen = set()
         inputs: List[Any] = []
         for n in members:
             for d in graph.get_dependencies(n):
-                if d not in mset and d not in inputs:
+                if d not in mset and d not in seen:
+                    seen.add(d)
                     inputs.append(d)
+        # the pinned inputs contract: topological order of the producer,
+        # NOT insertion order over members (which varies with grouping)
+        inputs.sort(key=lambda d: full_pos[d])
         outputs = [
             n for n in members
             if n in sink_deps or (consumers.get(n, set()) - mset)

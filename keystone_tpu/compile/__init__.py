@@ -35,22 +35,47 @@ from .fingerprint import (
     entry_key,
     environment_key,
     pipeline_fingerprint,
+    segment_entry_key,
+    segment_fingerprint,
 )
-from .manifest import exported_signatures, record_export
+from .manifest import (
+    exported_signatures,
+    record_export,
+    record_segment,
+    segment_digests,
+    segment_signatures,
+)
+from .segment import (
+    SegmentBinding,
+    SegmentDispatcher,
+    bind_segment,
+    lower_segment,
+    prewarm_segment_artifacts,
+)
 
 __all__ = [
     "AotDispatcher",
     "CacheEntry",
     "ExecutableCache",
     "FingerprintError",
+    "SegmentBinding",
+    "SegmentDispatcher",
+    "bind_segment",
     "configure",
     "entry_key",
     "environment_key",
     "exported_signatures",
     "get_cache",
+    "lower_segment",
     "pipeline_fingerprint",
+    "prewarm_segment_artifacts",
     "record_export",
+    "record_segment",
     "reset",
+    "segment_digests",
+    "segment_entry_key",
+    "segment_fingerprint",
+    "segment_signatures",
     "signature_of",
 ]
 

@@ -299,6 +299,35 @@ def pipeline_fingerprint(fitted) -> str:
     return h.hexdigest()
 
 
+def segment_fingerprint(graph, segment) -> str:
+    """Hex sha256 of one :class:`~keystone_tpu.check.segments.Segment`'s
+    content: member operator states + the segment-local dependency wiring
+    + the output slots. The index space is positional over
+    ``segment.inputs`` followed by ``segment.nodes`` (both pinned to
+    topological order by the planner), so the digest is invariant to the
+    arbitrary integer ids graph splicing assigns — two processes planning
+    the same fitted pipeline produce the same segment digests, which is
+    what lets a warm fit load another process's exported segment
+    executables. Raises :class:`FingerprintError` when any member state
+    has no content-stable form (the caller falls back to node dispatch)."""
+    h = hashlib.sha256()
+    _feed_bytes(h, b"V", f"seg{FORMAT_VERSION}".encode())
+    pos: Dict[Any, int] = {d: i for i, d in enumerate(segment.inputs)}
+    for j, n in enumerate(segment.nodes):
+        pos[n] = len(segment.inputs) + j
+    for n in segment.nodes:
+        op = graph.get_operator(n)
+        _feed_bytes(h, b"n", str(pos[n]).encode())
+        _feed_operator_state(h, op, op.label)
+        _feed(
+            h,
+            tuple(pos[d] for d in graph.get_dependencies(n)),
+            f"{op.label}.deps",
+        )
+    _feed(h, tuple(pos[o] for o in segment.outputs), "outputs")
+    return h.hexdigest()
+
+
 def environment_key() -> Dict[str, str]:
     """What must match for a cached executable to be loadable: jax/jaxlib
     versions, the backend, and the device kind. Initializes the backend
@@ -330,3 +359,21 @@ def entry_key(
     _feed_bytes(h, b"y", str(dtype).encode())
     _feed(h, {str(k): str(v) for k, v in env.items()}, "env")
     return f"{pipeline_digest[:32]}-{h.hexdigest()[:24]}"
+
+
+def segment_entry_key(
+    segment_digest: str,
+    signatures: Tuple[Tuple[Tuple[int, ...], str], ...],
+    env: Dict[str, str],
+) -> str:
+    """Cache-entry key for one (segment, input-signature tuple,
+    environment). The multi-input analogue of :func:`entry_key`: a
+    segment function takes one array per segment input, so the key feeds
+    every ``(shape, dtype)`` positionally."""
+    h = hashlib.sha256()
+    _feed_bytes(h, b"G", segment_digest.encode())
+    for shape, dtype in signatures:
+        _feed(h, tuple(int(d) for d in shape), "shape")
+        _feed_bytes(h, b"y", str(dtype).encode())
+    _feed(h, {str(k): str(v) for k, v in env.items()}, "env")
+    return f"{segment_digest[:32]}-{h.hexdigest()[:24]}"

@@ -1,0 +1,656 @@
+"""Segment compilation: lower a planned traceable segment into ONE jitted
+function and dispatch it through the AOT export/cache/manifest plane.
+
+``check/segments.py`` (PR 13) partitions the optimized DAG into maximal
+traceable segments between materialization barriers. This module is the
+payoff: :func:`lower_segment` composes the member operators'
+``trace_batch`` bodies in topo order into a single function over the
+segment's pinned ``inputs`` → ``outputs`` tuple, and
+:class:`SegmentDispatcher` resolves one executable per input-signature
+tuple exactly the way :class:`~keystone_tpu.compile.aot.AotDispatcher`
+does for serving buckets — cache hit ⇒ deserialize, zero traces; miss ⇒
+trace once via ``jax.export``, persist, index in the segment manifest so
+a warm boot (``ServingFleet.start()``, cluster workers) pre-warms it.
+A warm FIT therefore boots zero-trace.
+
+:class:`SegmentBinding` is the executor-facing handle: it owns the
+lowered steps, the content digest, and the three runtime paths —
+
+* **compiled** — all-batched array inputs dispatch the whole segment as
+  one program (one Python dispatch for N nodes);
+* **chunked** — a single-output segment over chunked data rides the
+  out-of-core scan per chunk through :class:`ChunkPadder` (ragged final
+  chunks pad to the bucket ladder, results slice back);
+* **fallback** — anything else (item-list inputs, batch-coupled members
+  over chunks, multi-output chunked segments, a runtime failure) degrades
+  to exact per-node semantics: same operators, same order, same answers.
+
+Adaptive boundaries close the loop through ``cost/segments.py``: each
+compile and each run is recorded under the profile store's
+``plan/segment/`` namespace, and a segment whose observed compile cost
+swamps its cumulative dispatch savings is demoted back to node dispatch
+on the next fit. ``KEYSTONE_SEGMENT_COMPILE=0`` kill-switches the whole
+layer (read per pull by the executor, not here).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs.tracer import current as _trace_current
+from .aot import Signature, signature_of
+from .cache import ExecutableCache
+from .fingerprint import (
+    FingerprintError,
+    environment_key,
+    segment_entry_key,
+    segment_fingerprint,
+)
+
+logger = logging.getLogger(__name__)
+
+#: lowered step: (operator, input slots into the segment value vector)
+Step = Tuple[Any, Tuple[int, ...]]
+
+
+def lower_segment(graph: Any, segment: Any) -> Tuple[Callable, List[Step], Tuple[int, ...]]:
+    """Compose ``segment``'s member ``trace_batch`` bodies into one
+    function ``fn(*inputs) -> outputs tuple``.
+
+    The index space is positional over ``segment.inputs`` followed by
+    ``segment.nodes`` — the same space :func:`segment_fingerprint` hashes,
+    so two processes that agree on the digest agree on the signature.
+    Returns ``(fn, steps, out_slots)``; ``steps``/``out_slots`` also
+    drive the exact-semantics fallback path.
+    """
+    inputs = list(segment.inputs)
+    members = list(segment.nodes)
+    pos: Dict[Any, int] = {d: i for i, d in enumerate(inputs)}
+    for j, n in enumerate(members):
+        pos[n] = len(inputs) + j
+    steps: List[Step] = [
+        (
+            graph.get_operator(n),
+            tuple(pos[d] for d in graph.get_dependencies(n)),
+        )
+        for n in members
+    ]
+    out_slots = tuple(pos[o] for o in segment.outputs)
+
+    def fn(*xs):
+        values = list(xs)
+        for op, slots in steps:
+            values.append(op.trace_batch(*[values[s] for s in slots]))
+        return tuple(values[s] for s in out_slots)
+
+    return fn, steps, out_slots
+
+
+class SegmentDispatcher:
+    """One executable per input-signature tuple, cache-first — the
+    segment-graph sibling of :class:`~keystone_tpu.compile.aot.AotDispatcher`.
+
+    With no cache configured every signature resolves to a structural
+    ``jax.jit`` (still one program per segment, just not exported). Inputs
+    that have no array signature (tuple payloads out of a gather join)
+    also ride the structural jit: jit handles pytrees natively, only the
+    AOT export plane needs flat array signatures.
+    """
+
+    def __init__(
+        self,
+        fn: Callable,
+        digest: str,
+        cache: Optional[ExecutableCache],
+        *,
+        label: str = "",
+        n_nodes: int = 1,
+    ):
+        self._fn = fn
+        self._digest = digest
+        self._cache = cache
+        self._label = label
+        self._n_nodes = n_nodes
+        self._env = environment_key() if cache is not None else None
+        self._by_sig: Dict[Tuple[Signature, ...], Callable] = {}
+        self._structural: Optional[Callable] = None
+        self._lock = threading.Lock()
+        self._loaded = 0
+        self._traced = 0
+        self._ledger = None
+        if cache is not None:
+            from ..obs.ledger import CompileLedger
+
+            self._ledger = CompileLedger.for_cache_root(cache.root)
+
+    @property
+    def digest(self) -> str:
+        return self._digest
+
+    @property
+    def loaded_count(self) -> int:
+        """Signature tuples resolved from the cache (zero traces paid)."""
+        return self._loaded
+
+    @property
+    def traced_count(self) -> int:
+        """Signature tuples that paid a live trace."""
+        return self._traced
+
+    def __call__(self, *xs):
+        try:
+            sigs = tuple(signature_of(x) for x in xs)
+        except (AttributeError, TypeError):
+            # non-array input (e.g. a gather join's tuple payload): jit
+            # dispatches pytrees fine, only AOT export needs flat arrays
+            return self._structural_jit()(*xs)
+        call = self._by_sig.get(sigs)
+        if call is None:
+            call = self._resolve(sigs)
+        return call(*xs)
+
+    def _structural_jit(self) -> Callable:
+        call = self._structural
+        if call is None:
+            import jax
+
+            with self._lock:
+                if self._structural is None:
+                    self._structural = jax.jit(self._fn)
+                call = self._structural
+        return call
+
+    def _resolve(self, sigs: Tuple[Signature, ...]) -> Callable:
+        with self._lock:
+            call = self._by_sig.get(sigs)
+            if call is not None:
+                return call
+            if self._cache is None:
+                import jax
+
+                if self._structural is None:
+                    self._structural = jax.jit(self._fn)
+                call = self._structural
+            else:
+                call = self._load(sigs)
+                if call is None:
+                    call = self._trace_and_export(sigs)
+            self._by_sig[sigs] = call
+            return call
+
+    def _load(self, sigs: Tuple[Signature, ...]) -> Optional[Callable]:
+        import jax
+        from jax import export as jax_export
+
+        key = segment_entry_key(self._digest, sigs, self._env)
+        t0 = time.perf_counter()
+        entry = self._cache.load(key, expect_env=self._env)
+        if entry is None:
+            return None
+        try:
+            exported = jax_export.deserialize(bytearray(entry.payload))
+            call = jax.jit(exported.call)
+        except Exception:
+            logger.warning(
+                "segment: undeserializable entry for %s — falling back to "
+                "live compile", self._label or key, exc_info=True,
+            )
+            self._cache._discard(entry.path, "undeserializable")
+            return None
+        self._loaded += 1
+        load_seconds = time.perf_counter() - t0
+        if self._ledger is not None:
+            self._ledger.record(
+                "load",
+                key=key,
+                label=self._label,
+                kind="segment",
+                inputs=len(sigs),
+                nbytes=entry.nbytes,
+                seconds=load_seconds,
+                saved_s=entry.header.get("trace_seconds"),
+            )
+        tracer = _trace_current()
+        if tracer is not None:
+            tracer.instant(
+                "aot.load",
+                op_type="SegmentDispatcher",
+                key=key,
+                label=self._label,
+                inputs=len(sigs),
+                bytes=entry.nbytes,
+                load_seconds=round(load_seconds, 4),
+                seconds_saved=entry.header.get("trace_seconds"),
+            )
+        logger.info(
+            "segment: loaded %s from cache (%d bytes, saved ~%ss of "
+            "tracing)", self._label or key, entry.nbytes,
+            entry.header.get("trace_seconds", "?"),
+        )
+        return call
+
+    def _trace_and_export(self, sigs: Tuple[Signature, ...]) -> Callable:
+        import jax
+        import numpy as np
+        from jax import export as jax_export
+
+        from ..cost import segments as seg_cost
+
+        tracer = _trace_current()
+        key = segment_entry_key(self._digest, sigs, self._env)
+        if tracer is not None:
+            tracer.instant(
+                "aot.miss", op_type="SegmentDispatcher", key=key,
+                label=self._label, inputs=len(sigs),
+            )
+        specs = [jax.ShapeDtypeStruct(s, np.dtype(d)) for s, d in sigs]
+        t0 = time.perf_counter()
+        try:
+            exported = jax_export.export(jax.jit(self._fn))(*specs)
+            call = jax.jit(exported.call)
+        except Exception:
+            logger.warning(
+                "segment: export failed for %s — dispatching via plain jit "
+                "(no cross-process caching for this signature)",
+                self._label or key, exc_info=True,
+            )
+            self._traced += 1
+            seg_cost.record_compile(
+                self._digest, time.perf_counter() - t0,
+                exported=False, n_nodes=self._n_nodes,
+            )
+            return jax.jit(self._fn)
+        trace_seconds = time.perf_counter() - t0
+        self._traced += 1
+        if self._ledger is not None:
+            self._ledger.record(
+                "trace",
+                key=key,
+                label=self._label,
+                kind="segment",
+                inputs=len(sigs),
+                seconds=trace_seconds,
+            )
+        try:
+            payload = bytes(exported.serialize())
+            self._cache.store(
+                key,
+                payload,
+                {
+                    "env": self._env,
+                    "segment": self._digest,
+                    "inputs": [[list(s), d] for s, d in sigs],
+                    "label": self._label,
+                    "trace_seconds": round(trace_seconds, 4),
+                    "created_unix": time.time(),
+                },
+            )
+            from . import manifest as _manifest
+
+            _manifest.record_segment(self._cache, self._digest, sigs)
+        except Exception:
+            logger.warning(
+                "segment: could not persist %s — executable still serves "
+                "live", self._label or key, exc_info=True,
+            )
+            payload = b""
+        if payload and self._ledger is not None:
+            self._ledger.record(
+                "export",
+                key=key,
+                label=self._label,
+                kind="segment",
+                inputs=len(sigs),
+                nbytes=len(payload),
+                seconds=trace_seconds,
+            )
+        if tracer is not None:
+            tracer.instant(
+                "aot.export",
+                op_type="SegmentDispatcher",
+                key=key,
+                label=self._label,
+                inputs=len(sigs),
+                bytes=len(payload),
+                trace_seconds=round(trace_seconds, 4),
+            )
+        seg_cost.record_compile(
+            self._digest, trace_seconds, exported=bool(payload),
+            n_nodes=self._n_nodes,
+        )
+        return call
+
+
+# ---------------------------------------------------------------------------
+# Process-wide dispatcher registry: one SegmentDispatcher per (digest,
+# cache root), so two executors pulling the same fitted graph share
+# resolved executables instead of re-tracing. Bounded LRU — digests churn
+# across unrelated pipelines in a long-lived process.
+# ---------------------------------------------------------------------------
+
+_DISPATCHERS: "OrderedDict[Tuple[str, Optional[str]], SegmentDispatcher]" = OrderedDict()
+_MAX_DISPATCHERS = 128
+_dispatchers_lock = threading.Lock()
+
+
+def dispatcher_for(
+    digest: str, fn_factory: Callable[[], Callable], *, label: str = "",
+    n_nodes: int = 1,
+) -> SegmentDispatcher:
+    """The shared dispatcher for ``digest`` against the currently
+    configured cache. The cache is re-fetched per call (it may be
+    configured after a binding was built), so bindings must not memoize
+    the dispatcher they get back."""
+    from . import get_cache
+
+    cache = get_cache()
+    key = (digest, cache.root if cache is not None else None)
+    with _dispatchers_lock:
+        disp = _DISPATCHERS.get(key)
+        if disp is not None:
+            _DISPATCHERS.move_to_end(key)
+            return disp
+        disp = SegmentDispatcher(
+            fn_factory(), digest, cache, label=label, n_nodes=n_nodes
+        )
+        _DISPATCHERS[key] = disp
+        while len(_DISPATCHERS) > _MAX_DISPATCHERS:
+            _DISPATCHERS.popitem(last=False)
+        return disp
+
+
+def reset_dispatchers() -> None:
+    """Drop every registered dispatcher (test hygiene)."""
+    with _dispatchers_lock:
+        _DISPATCHERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# SegmentBinding: the executor-facing handle
+# ---------------------------------------------------------------------------
+
+
+class SegmentBinding:
+    """One plannable segment, lowered and ready to dispatch.
+
+    ``run(datasets)`` takes the materialized input Datasets (positional
+    over the segment's pinned ``inputs`` order) and returns
+    ``(outputs, path)`` — one Dataset per segment output plus which
+    runtime path served it (``compiled`` / ``chunked`` / ``fallback``).
+    Any runtime failure demotes the binding permanently (this process)
+    and re-runs through exact node semantics — segment dispatch must
+    never change answers or surface new errors.
+    """
+
+    def __init__(
+        self,
+        *,
+        index: int,
+        inputs: List[Any],
+        outputs: List[Any],
+        fn: Callable,
+        steps: List[Step],
+        out_slots: Tuple[int, ...],
+        digest: str,
+        label: str,
+        node_ids: List[str],
+        batch_coupled: bool,
+    ):
+        self.index = index
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.fn = fn
+        self.steps = steps
+        self.out_slots = out_slots
+        self.digest = digest
+        self.label = label
+        self.node_ids = list(node_ids)
+        self.batch_coupled = batch_coupled
+        self._demoted = False
+
+    def __len__(self) -> int:
+        return len(self.steps)
+
+    def _dispatcher(self) -> SegmentDispatcher:
+        return dispatcher_for(
+            self.digest, lambda: self.fn, label=self.label,
+            n_nodes=len(self.steps),
+        )
+
+    def run(self, datasets: List[Any]) -> Tuple[Tuple[Any, ...], str]:
+        if self._demoted:
+            return self._fallback(datasets), "fallback"
+        try:
+            return self._run(datasets)
+        except Exception as e:
+            self._demote(f"runtime failure: {e!r}")
+            return self._fallback(datasets), "fallback"
+
+    def _run(self, datasets: List[Any]) -> Tuple[Tuple[Any, ...], str]:
+        from ..data.chunked import ChunkedDataset, align_and_zip
+        from ..data.dataset import Dataset
+        from ..data.pipeline_scan import ChunkPadder
+
+        # ChunkedDataset reports is_batched=True — check it FIRST
+        if any(isinstance(ds, ChunkedDataset) for ds in datasets):
+            if self.batch_coupled or len(self.out_slots) != 1:
+                # batch-coupled members must see whole batches, and a
+                # multi-output chunked segment would rescan the source
+                # once per output — node semantics handle both exactly
+                return self._fallback(datasets), "fallback"
+            disp = self._dispatcher()
+            if len(datasets) == 1:
+                out = datasets[0].map_batch(
+                    ChunkPadder(lambda c: disp(c)[0], shard=True)
+                )
+            else:
+                out = align_and_zip(list(datasets)).map_batch(
+                    ChunkPadder(lambda t: disp(*t)[0], shard=True)
+                )
+            return (out,), "chunked"
+        if datasets and all(ds.is_batched for ds in datasets):
+            from ..cost import segments as seg_cost
+
+            disp = self._dispatcher()
+            t0 = time.perf_counter()
+            raw = disp(*[ds.to_array() for ds in datasets])
+            seg_cost.record_run(
+                self.digest, time.perf_counter() - t0,
+                n_nodes=len(self.steps),
+            )
+            return (
+                tuple(Dataset(o, batched=True) for o in raw),
+                "compiled",
+            )
+        # item-list inputs: per-node dispatch is the honest semantics
+        return self._fallback(datasets), "fallback"
+
+    def _fallback(self, datasets: List[Any]) -> Tuple[Any, ...]:
+        """Exact node semantics: same operators, same topo order, same
+        execute() paths the node executor would have run."""
+        from ..workflow.expressions import DatasetExpression
+
+        values: List[Any] = list(datasets)
+        for op, slots in self.steps:
+            deps = [DatasetExpression.now(values[s]) for s in slots]
+            values.append(op.execute(deps).get())
+        return tuple(values[s] for s in self.out_slots)
+
+    def _demote(self, why: str) -> None:
+        if self._demoted:
+            return
+        self._demoted = True
+        logger.warning(
+            "segment %s (%s): %s — demoted to node dispatch",
+            self.index, self.label, why, exc_info=True,
+        )
+        try:
+            from ..cost import segments as seg_cost
+
+            seg_cost.record_failure(self.digest, why="runtime")
+        except Exception:
+            logger.debug("segment: could not record demotion", exc_info=True)
+
+
+def bind_segment(
+    graph: Any, segment: Any, *, annotations: Optional[Dict[Any, str]] = None
+) -> Optional[SegmentBinding]:
+    """Lower ``segment`` into a dispatchable binding, or None when it is
+    not worth (or not safe to) segment-dispatch:
+
+    * empty, or a singleton whose operator is not already a fused chain —
+      a single plain node gains nothing over its node thunk, but a
+      singleton :class:`FusedTransformerOperator` IS eligible: that is
+      how an optimizer-fused fit graph gets whole-chain AOT export;
+    * any member annotated for the pipeline env whose value would NOT
+      surface (interior annotated nodes must materialize individually);
+    * any member without a traceable ``trace_batch`` (defense in depth —
+      the planner's lattice should have barriered these already);
+    * the segment fingerprint is uncomputable (unhashable operator
+      state);
+    * the cost model demoted this digest (compile cost exceeded observed
+      dispatch savings — the adaptive-boundary split).
+    """
+    from ..workflow.fusion import FusedTransformerOperator
+    from ..workflow.graph import NodeId
+    from ..workflow.operators import TransformerOperator
+
+    members = list(segment.nodes)
+    if not members:
+        return None
+    ops = []
+    for n in members:
+        op = graph.get_operator(n)
+        if not isinstance(op, TransformerOperator):
+            return None
+        if not callable(getattr(op, "trace_batch", None)):
+            return None
+        ops.append(op)
+    if len(members) == 1 and not isinstance(ops[0], FusedTransformerOperator):
+        return None
+    out_set = set(segment.outputs)
+    if annotations:
+        for n in members:
+            if n in annotations and n not in out_set:
+                return None
+    for d in segment.inputs:
+        if not isinstance(d, NodeId):
+            return None
+    # convexity: a member → barrier → member path makes an INPUT of the
+    # lowered function transitively depend on one of its OUTPUTS (e.g. a
+    # shared prefix feeding both a host node and a traceable chain the
+    # host node rejoins). Such a group is not one compilation unit.
+    mset = set(members)
+    stack: List[Any] = []
+    for d in segment.inputs:
+        stack.extend(graph.get_dependencies(d))
+    seen_anc = set()
+    while stack:
+        a = stack.pop()
+        if a in seen_anc:
+            continue
+        seen_anc.add(a)
+        if a in mset:
+            return None
+        if isinstance(a, NodeId) and a in graph.operators:
+            stack.extend(graph.get_dependencies(a))
+    try:
+        digest = segment_fingerprint(graph, segment)
+    except FingerprintError:
+        logger.debug(
+            "segment %s: unfingerprintable — node dispatch", segment.index,
+            exc_info=True,
+        )
+        return None
+    from ..cost import segments as seg_cost
+
+    if not seg_cost.should_compile(digest, len(members)):
+        logger.info(
+            "segment %s: demoted by cost model — node dispatch",
+            segment.index,
+        )
+        return None
+    fn, steps, out_slots = lower_segment(graph, segment)
+    labels = [op.label for op in ops]
+    label = "+".join(labels)
+    if len(label) > 96:
+        label = label[:93] + "..."
+    return SegmentBinding(
+        index=segment.index,
+        inputs=list(segment.inputs),
+        outputs=list(segment.outputs),
+        fn=fn,
+        steps=steps,
+        out_slots=out_slots,
+        digest=digest,
+        label=label,
+        node_ids=[str(n.id) for n in members],
+        batch_coupled=any(
+            bool(getattr(op, "batch_coupled", False)) for op in ops
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Warm boot: pre-warm every manifest-indexed segment executable
+# ---------------------------------------------------------------------------
+
+
+def prewarm_segment_artifacts(
+    cache: ExecutableCache, *, limit: int = 64, max_elements: int = 1 << 22
+) -> int:
+    """Deserialize + compile + execute-once every segment executable the
+    manifest indexes — the fit-side analogue of the serving fleet's bucket
+    pre-warm, called from ``ServingFleet.start()`` so a warm fit after a
+    warm serve boot loads and never traces. Returns the number warmed.
+    Best-effort throughout: a missing/evicted/foreign entry is skipped,
+    never a boot failure. ``max_elements`` bounds the dummy-input bytes a
+    boot will allocate per signature tuple."""
+    import jax
+    import numpy as np
+    from jax import export as jax_export
+
+    from . import manifest as _manifest
+
+    env = environment_key()
+    warmed = 0
+    for digest in _manifest.segment_digests(cache):
+        if warmed >= limit:
+            break
+        for sigs in _manifest.segment_signatures(cache, digest):
+            if warmed >= limit:
+                break
+            try:
+                elements = sum(
+                    int(np.prod(shape)) if shape else 1 for shape, _ in sigs
+                )
+                if elements > max_elements:
+                    logger.info(
+                        "segment prewarm: skipping %s (%d elements over "
+                        "budget)", digest[:16], elements,
+                    )
+                    continue
+                key = segment_entry_key(digest, sigs, env)
+                entry = cache.load(key, expect_env=env)
+                if entry is None:
+                    continue
+                exported = jax_export.deserialize(bytearray(entry.payload))
+                call = jax.jit(exported.call)
+                args = [
+                    jax.numpy.zeros(shape, np.dtype(dtype))
+                    for shape, dtype in sigs
+                ]
+                jax.block_until_ready(call(*args))
+                warmed += 1
+            except Exception:
+                logger.warning(
+                    "segment prewarm: could not warm %s — skipped",
+                    digest[:16], exc_info=True,
+                )
+    if warmed:
+        logger.info("segment prewarm: %d executable(s) warmed", warmed)
+    return warmed

@@ -23,6 +23,10 @@ backend/device-kind isolated; see ``cost/store.py``):
 * ``plan/<graph-fp>`` — per-node observed seconds/bytes (+ the measured
   estimate-vs-observed ``ratio``) for one pipeline: the evidence the
   cache planner plans from with zero sampling executions.
+* ``plan/segment/<segment-digest>`` — per-segment compile-vs-run
+  evidence for segment-compiled execution (``cost/segments.py``): the
+  adaptive-boundary policy that splits a segment back to node dispatch
+  when its compile cost swamps its dispatch savings.
 
 Knobs: ``KEYSTONE_PROFILE_DIR=<dir>`` (or ``--profiles`` on the CLI, or
 ``utils.obs.configure(profiles=...)``) enables the store. Without it the

@@ -1,0 +1,132 @@
+"""Per-segment compile-vs-run evidence: the adaptive-boundary half of
+segment compilation.
+
+Every segment compile (trace+export) and every compiled dispatch lands
+here under the profile store's ``plan/segment/<digest>`` namespace. The
+policy question the evidence answers is the ISSUE's split rule: *has this
+segment's compile cost exceeded the dispatch savings its runs have
+earned?* Dispatch savings per run are modeled as
+``(n_nodes - 1) * KEYSTONE_SEGMENT_DISPATCH_COST`` — the Python
+thunk/span overhead a fused dispatch avoids per subsumed node (default
+200µs, tunable per deployment).
+
+Demotion only fires on *unexported* segments with at least
+``MIN_RUNS_FOR_DEMOTION`` runs of evidence: an exported segment's compile
+is a sunk, cross-process-amortized cost (warm boots load it for free), so
+charging it against this process's runs would demote exactly the segments
+the AOT plane makes cheap. A runtime failure demotes unconditionally.
+
+Everything is best-effort: with no profile store configured
+(``KEYSTONE_PROFILE_DIR`` unset) every function no-ops and
+:func:`should_compile` says yes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from ..utils import env_float
+
+logger = logging.getLogger(__name__)
+
+#: runs of evidence required before compile-vs-savings can demote
+MIN_RUNS_FOR_DEMOTION = 8
+
+
+def dispatch_overhead_s() -> float:
+    """Modeled per-node Python dispatch overhead a fused segment dispatch
+    saves (seconds). ``KEYSTONE_SEGMENT_DISPATCH_COST`` overrides."""
+    return env_float("KEYSTONE_SEGMENT_DISPATCH_COST", 2e-4)
+
+
+def _key(digest: str) -> str:
+    return "plan/segment/" + digest[:32]
+
+
+def should_compile(digest: str, n_nodes: int) -> bool:
+    """The next-fit policy read: False iff the evidence demoted this
+    segment back to node dispatch. No store / no record ⇒ compile."""
+    from . import get_store
+
+    store = get_store()
+    if store is None:
+        return True
+    rec = store.load(_key(digest))
+    if rec is None:
+        return True
+    return not bool(rec.get("demoted"))
+
+
+def record_compile(
+    digest: str, seconds: float, *, exported: bool, n_nodes: int
+) -> None:
+    """One trace (+export when it landed) was paid for ``digest``."""
+    from . import get_store
+
+    store = get_store()
+    if store is None:
+        return
+
+    def merge(rec: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        rec = dict(rec or {})
+        rec["compiles"] = int(rec.get("compiles", 0)) + 1
+        rec["compile_s"] = float(rec.get("compile_s", 0.0)) + float(seconds)
+        rec["exported"] = bool(rec.get("exported")) or bool(exported)
+        rec["nodes"] = int(n_nodes)
+        return _evaluate(rec)
+
+    store.update(_key(digest), merge)
+
+
+def record_run(digest: str, seconds: float, *, n_nodes: int) -> None:
+    """One compiled whole-segment dispatch ran for ``digest``."""
+    from . import get_store
+
+    store = get_store()
+    if store is None:
+        return
+
+    def merge(rec: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        rec = dict(rec or {})
+        rec["runs"] = int(rec.get("runs", 0)) + 1
+        rec["run_s"] = float(rec.get("run_s", 0.0)) + float(seconds)
+        rec["nodes"] = int(rec.get("nodes", n_nodes))
+        return _evaluate(rec)
+
+    store.update(_key(digest), merge)
+
+
+def record_failure(digest: str, *, why: str = "runtime") -> None:
+    """A compiled dispatch raised — demote unconditionally; the fallback
+    already served the answer through node semantics."""
+    from . import get_store
+
+    store = get_store()
+    if store is None:
+        return
+
+    def merge(rec: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        rec = dict(rec or {})
+        rec["demoted"] = True
+        rec["why"] = why
+        return rec
+
+    store.update(_key(digest), merge)
+
+
+def _evaluate(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """The split rule, applied in place on every evidence update."""
+    if rec.get("demoted") or rec.get("exported"):
+        # exported ⇒ the compile amortizes across every future process
+        # (warm boots load it); never demote on this process's ledger
+        return rec
+    runs = int(rec.get("runs", 0))
+    if runs < MIN_RUNS_FOR_DEMOTION:
+        return rec
+    nodes = int(rec.get("nodes", 1))
+    savings = runs * max(nodes - 1, 0) * dispatch_overhead_s()
+    if float(rec.get("compile_s", 0.0)) > savings:
+        rec["demoted"] = True
+        rec["why"] = "compile_exceeds_savings"
+    return rec

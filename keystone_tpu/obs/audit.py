@@ -70,6 +70,20 @@ def observed_by_node(tracer: Tracer, start: int = 0) -> Dict[str, dict]:
     return out
 
 
+def segment_member_ids(tracer: Tracer, start: int = 0) -> set:
+    """Node ids dispatched INSIDE a compiled segment (``exec.segment``
+    spans carry their member ``node_ids``): these nodes never emit their
+    own executor span, by design — the audit must not report them as
+    mis-planned just because segment dispatch subsumed them."""
+    out: set = set()
+    for sp in tracer.spans()[start:]:
+        if sp.name != "exec.segment":
+            continue
+        for nid in sp.attrs.get("node_ids") or ():
+            out.add(str(nid))
+    return out
+
+
 def _ratio(observed: Optional[float], estimated: Optional[float]) -> Optional[float]:
     if observed is None or not estimated:
         return None
@@ -88,6 +102,7 @@ def cache_audit(tracer: Optional[Tracer] = None) -> List[dict]:
     if tracer is None:
         return []
     observed = observed_by_node(tracer)
+    in_segment = segment_member_ids(tracer)
     rows = []
     for node_id, est in tracer.estimates.items():
         obs = observed.get(node_id)
@@ -111,6 +126,9 @@ def cache_audit(tracer: Optional[Tracer] = None) -> List[dict]:
             ),
             "cache_hits": 0 if obs is None else obs["hits"],
             "observed": obs is not None,
+            # unobserved because a whole-segment dispatch subsumed it —
+            # an expected outcome of segment compilation, not a finding
+            "segment": obs is None and node_id in in_segment,
         }
         if est.get("kind") == "solver":
             row["solver"] = est.get("solver")
@@ -156,6 +174,10 @@ def log_cache_audit(tracer: Optional[Tracer] = None) -> List[dict]:
             fmt(r["seconds_ratio"]),
             fmt(r["bytes_ratio"]),
             r["cache_hits"],
-            "" if r["observed"] else " NEVER OBSERVED (fused away or unexecuted)",
+            "" if r["observed"] else (
+                " SUBSUMED BY SEGMENT (dispatched inside a compiled segment)"
+                if r.get("segment")
+                else " NEVER OBSERVED (fused away or unexecuted)"
+            ),
         )
     return rows

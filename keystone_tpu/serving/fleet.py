@@ -336,6 +336,7 @@ class ServingFleet:
             seen.add((shape, dtype))
             inputs.append(np.zeros(shape, dtype=dtype))
         self._warm_inputs(self._replicas[0].compiled, inputs)
+        self._prewarm_segments()
         logger.info(
             "fleet warm-up: %d signature(s) ready across %d device(s) "
             "(%d traced, %d loaded from the AOT cache)",
@@ -344,6 +345,31 @@ class ServingFleet:
             self._metrics.count("aot_loads"),
         )
         return len(inputs)
+
+    def _prewarm_segments(self) -> None:
+        """Pre-warm every segment executable the AOT cache's segment
+        manifest indexes (:mod:`keystone_tpu.compile.segment`) — so a
+        warm FIT issued after this boot (a refit on the serving host, a
+        cluster worker's local fit) loads whole-segment programs instead
+        of tracing them. Best-effort: segment warm-up must never fail a
+        fleet that serves fine without it."""
+        from .. import compile as compile_mod
+
+        cache = compile_mod.get_cache()
+        if cache is None:
+            return
+        try:
+            warmed = compile_mod.prewarm_segment_artifacts(cache)
+            if warmed:
+                logger.info(
+                    "fleet warm-up: %d segment executable(s) pre-warmed",
+                    warmed,
+                )
+        except Exception:
+            logger.warning(
+                "fleet warm-up: segment pre-warm failed — warm fits will "
+                "load lazily", exc_info=True,
+            )
 
     def _distinct_devices(self) -> list:
         seen, out = set(), []

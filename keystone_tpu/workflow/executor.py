@@ -28,17 +28,20 @@ original exception propagates with its original traceback.
 from __future__ import annotations
 
 import heapq
+import logging
 import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..obs.tracer import current as _trace_current
 from .env import PipelineEnv
-from .expressions import Expression
+from .expressions import DatasetExpression, Expression
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
 from .rules import Annotations
+
+logger = logging.getLogger(__name__)
 
 # -- concurrency knobs -------------------------------------------------------
 
@@ -49,6 +52,15 @@ def parallel_enabled() -> bool:
     from ..utils import env_flag
 
     return env_flag("KEYSTONE_PAR_EXEC", True)
+
+
+def segment_compile_enabled() -> bool:
+    """``KEYSTONE_SEGMENT_COMPILE`` kill switch (default on). Read per
+    pull, so one env flip drops the whole layer back to node dispatch
+    without rebuilding executors."""
+    from ..utils import env_flag
+
+    return env_flag("KEYSTONE_SEGMENT_COMPILE", True)
 
 
 def exec_workers() -> int:
@@ -102,6 +114,7 @@ class GraphExecutor:
         graph: Graph,
         optimize: bool = True,
         parallel: Optional[bool] = None,
+        segment_plan: Optional[Dict[NodeId, Any]] = None,
     ):
         self._input_graph = graph
         self._optimize = optimize
@@ -115,6 +128,23 @@ class GraphExecutor:
         #: guards expression-web construction + memo writes so concurrent
         #: pulls (serving threads) see a consistent ``_state``
         self._build_lock = threading.Lock()
+        #: segment-compiled dispatch plan: output NodeId → SegmentBinding,
+        #: planned once per executor on the first segment-enabled pull
+        #: (None = not yet planned; {} = planned, nothing eligible).
+        #: ``segment_plan`` seeds it with a caller-cached plan — a
+        #: FittedPipeline splices an identical graph per apply (node ids
+        #: are deterministic, operators are shared objects), so the plan
+        #: from apply #1's executor is valid for every later apply and
+        #: replanning per pull would pay fingerprint + lattice work on
+        #: the request path
+        self._seg_bindings: Optional[Dict[NodeId, Any]] = segment_plan
+
+    @property
+    def segment_plan(self) -> Optional[Dict[NodeId, Any]]:
+        """The planned segment-dispatch table (None until the first
+        segment-enabled pull plans it) — cacheable across executors over
+        identically-spliced graphs; see ``__init__``."""
+        return self._seg_bindings
 
     @property
     def input_graph(self) -> Graph:
@@ -162,20 +192,34 @@ class GraphExecutor:
 
     def execute(self, graph_id: GraphId) -> Expression:
         with self._build_lock:
+            segments: Optional[Dict[NodeId, Any]] = None
+            if segment_compile_enabled():
+                if self._seg_bindings is None:
+                    self._seg_bindings = self._plan_segment_bindings()
+                segments = self._seg_bindings or None
             built: Dict[NodeId, Expression] = {}
-            expr = self._execute(graph_id, transient={}, built=built)
+            expr = self._execute(
+                graph_id, transient={}, built=built, segments=segments
+            )
             if self._use_parallel():
-                self._arm_concurrent(expr, built)
+                self._arm_concurrent(expr, built, segments=segments)
         return expr
 
     def _execute(
-        self, graph_id: GraphId, transient: Dict, built: Dict[NodeId, Expression]
+        self,
+        graph_id: GraphId,
+        transient: Dict,
+        built: Dict[NodeId, Expression],
+        segments: Optional[Dict[NodeId, Any]] = None,
     ) -> Expression:
         graph = self.graph  # force optimization before anything runs
         if isinstance(graph_id, SourceId):
             raise ValueError(f"cannot execute unconnected {graph_id}")
         if isinstance(graph_id, SinkId):
-            return self._execute(graph.get_sink_dependency(graph_id), transient, built)
+            return self._execute(
+                graph.get_sink_dependency(graph_id), transient, built,
+                segments=segments,
+            )
         # tracing is opt-in: disabled, the ONLY cost per pull is this None
         # check — no span allocation anywhere on the path
         tracer = _trace_current()
@@ -189,8 +233,18 @@ class GraphExecutor:
             if tracer is not None:
                 self._trace_hit(tracer, graph, graph_id, store="transient")
             return transient[graph_id]
+        if segments is not None:
+            binding = segments.get(graph_id)
+            if binding is not None:
+                expr = self._execute_segment(
+                    binding, graph_id, transient, built, segments
+                )
+                if expr is not None:
+                    return expr
+                # else: this pull cannot ride the segment (datum inputs) —
+                # fall through to plain node dispatch
         deps = [
-            self._execute(d, transient, built)
+            self._execute(d, transient, built, segments=segments)
             for d in graph.get_dependencies(graph_id)
         ]
         op = graph.get_operator(graph_id)
@@ -214,10 +268,121 @@ class GraphExecutor:
             PipelineEnv.get_or_create().state[prefix] = expr
         return expr
 
+    # -- segment-compiled dispatch --------------------------------------
+
+    def _plan_segment_bindings(self) -> Dict[NodeId, Any]:
+        """Plan this executor's segment-dispatch table: run the segment
+        planner over the (optimized) graph, lower every eligible segment
+        through ``compile/segment.py``, and key each binding by its OUTPUT
+        nodes (interiors are subsumed — they never get their own thunk).
+        Planning must never break execution: any failure degrades to an
+        empty table, i.e. plain node dispatch."""
+        try:
+            from ..check import lattice
+            from ..check.segments import plan_segments
+            from ..compile.segment import bind_segment
+
+            graph = self.graph
+            verdicts = {
+                n: lattice.classify(graph.get_operator(n))
+                for n in graph.nodes
+            }
+            planned, _barriers = plan_segments(graph, verdicts, {})
+            table: Dict[NodeId, Any] = {}
+            for seg in planned:
+                binding = bind_segment(
+                    graph, seg, annotations=self._annotations
+                )
+                if binding is None:
+                    continue
+                for out in binding.outputs:
+                    table[out] = binding
+            return table
+        except Exception:
+            logger.warning(
+                "segment planning failed — node dispatch for this executor",
+                exc_info=True,
+            )
+            return {}
+
+    def _execute_segment(
+        self,
+        binding: Any,
+        graph_id: NodeId,
+        transient: Dict,
+        built: Dict[NodeId, Expression],
+        segments: Dict[NodeId, Any],
+    ) -> Optional[Expression]:
+        """Build (or reuse) the ONE bundle expression for ``binding`` and
+        return the output expression for ``graph_id``. Returns None when
+        this pull's inputs are not dataset expressions (a datum pull) —
+        the caller falls back to node dispatch."""
+        outs_key = ("__segment_outs__", binding.index)
+        out_exprs = transient.get(outs_key)
+        if out_exprs is None:
+            in_exprs = [
+                self._execute(d, transient, built, segments=segments)
+                for d in binding.inputs
+            ]
+            if not all(isinstance(e, DatasetExpression) for e in in_exprs):
+                return None
+            bundle = self._segment_bundle(binding, in_exprs)
+            graph = self.graph
+            out_exprs = {}
+            for j, out in enumerate(binding.outputs):
+                oe = DatasetExpression(lambda j=j: bundle.get()[j])
+                out_exprs[out] = oe
+                built[out] = oe
+                if self._retain(graph, out):
+                    self._state[out] = oe
+                else:
+                    transient[out] = oe
+                prefix = self._annotations.get(out)
+                if prefix is not None:
+                    PipelineEnv.get_or_create().state[prefix] = oe
+            transient[outs_key] = out_exprs
+        return out_exprs.get(graph_id)
+
+    @staticmethod
+    def _segment_bundle(binding: Any, in_exprs: List[Expression]) -> Expression:
+        """The segment's single lazy thunk: force the input expressions
+        (OUTSIDE the segment span, so upstream node spans keep their own
+        attribution), then dispatch the whole segment as one program under
+        an ``exec.segment`` span — the span that replaces the N per-node
+        spans the members would have emitted."""
+
+        def run_bundle():
+            xs = [e.get() for e in in_exprs]
+            tracer = _trace_current()
+            if tracer is None:
+                outs, _path = binding.run(xs)
+                return outs
+            with tracer.span(
+                "exec.segment",
+                op_type="Segment",
+                segment=binding.index,
+                nodes=len(binding.node_ids),
+                node_ids=list(binding.node_ids),
+                digest=(binding.digest or "")[:16],
+                label=binding.label,
+            ) as sp:
+                outs, path = binding.run(xs)
+                sp.attrs["path"] = path
+                if path == "compiled":
+                    # chunked outputs are lazy scans — syncing them here
+                    # would force the whole out-of-core pass eagerly
+                    sp.sync_on(tuple(d.payload for d in outs))
+            return outs
+
+        return Expression(run_bundle)
+
     # -- concurrent scheduling ------------------------------------------
 
     def _arm_concurrent(
-        self, root_expr: Expression, built: Dict[NodeId, Expression]
+        self,
+        root_expr: Expression,
+        built: Dict[NodeId, Expression],
+        segments: Optional[Dict[NodeId, Any]] = None,
     ) -> None:
         """Wrap the pull root's thunk so its first forcing runs every other
         pending node of this pull through the dependency-counted worker
@@ -240,7 +405,14 @@ class GraphExecutor:
         children: Dict[NodeId, List[NodeId]] = {n: [] for n in sched}
         for n in sched:
             ds = []
-            for d in graph.get_dependencies(n):
+            # a segment output's graph dependencies are the segment's
+            # INTERIOR nodes — absent from ``built`` entirely; its true
+            # scheduling edges are the segment's external inputs
+            if segments is not None and n in segments:
+                dep_src = segments[n].inputs
+            else:
+                dep_src = graph.get_dependencies(n)
+            for d in dep_src:
                 if isinstance(d, NodeId) and d in in_sched and d not in ds:
                     ds.append(d)
             deps_of[n] = ds

@@ -624,6 +624,11 @@ class FittedPipeline(Chainable):
         self._compiled_signatures: List[tuple] = []
         #: memoized content fingerprint (the graph is immutable post-fit)
         self._fingerprint: Optional[str] = None
+        #: segment-dispatch plan cached across applies: every apply
+        #: splices an IDENTICAL graph (deterministic node ids, shared
+        #: operator objects), so apply #1's executor plan transfers and
+        #: later applies skip the fingerprint + lattice replanning work
+        self._segment_plan: Optional[dict] = None
 
     @property
     def graph(self) -> Graph:
@@ -650,21 +655,38 @@ class FittedPipeline(Chainable):
         graph, data_id = attach_data(self._graph, data)
         graph = graph.replace_dependency(self._source, data_id)
         graph = graph.remove_source(self._source)
-        executor = GraphExecutor(graph, optimize=False)
+        # the cached plan transfers only to the single-leaf splice: a
+        # PipelineResult splices its whole prefix graph, so node ids no
+        # longer line up with the plan's
+        plain_splice = not isinstance(data, PipelineResult)
+        executor = GraphExecutor(
+            graph, optimize=False,
+            segment_plan=self._segment_plan if plain_splice else None,
+        )
         tracer = _trace_current()
         if tracer is None:
-            return executor.execute(self._sink).get()
-        with tracer.span("pipeline.apply", op_type=type(self).__name__) as sp:
             value = executor.execute(self._sink).get()
-            sp.sync_on(value)
+        else:
+            with tracer.span(
+                "pipeline.apply", op_type=type(self).__name__
+            ) as sp:
+                value = executor.execute(self._sink).get()
+                sp.sync_on(value)
+        if plain_splice and self._segment_plan is None:
+            self._segment_plan = executor.segment_plan
         return value
 
     def apply_datum(self, datum: Any) -> Any:
         graph, datum_id = attach_datum(self._graph, datum)
         graph = graph.replace_dependency(self._source, datum_id)
         graph = graph.remove_source(self._source)
-        executor = GraphExecutor(graph, optimize=False)
-        return executor.execute(self._sink).get()
+        executor = GraphExecutor(
+            graph, optimize=False, segment_plan=self._segment_plan
+        )
+        value = executor.execute(self._sink).get()
+        if self._segment_plan is None:
+            self._segment_plan = executor.segment_plan
+        return value
 
     def __call__(self, data: Any) -> Any:
         return self.apply(data)
@@ -1206,13 +1228,15 @@ class FittedPipeline(Chainable):
         state = dict(self.__dict__)
         state["_compiled"] = None  # jitted callables don't pickle
         state["_compiled_signatures"] = []  # counts are per-live-jit
+        state["_segment_plan"] = None  # lowered closures don't pickle
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         # pickles from before compile-signature tracking / datum hints /
-        # AOT fingerprinting
+        # AOT fingerprinting / segment planning
         self.__dict__.setdefault("_compiled_signatures", [])
         self.__dict__.setdefault("datum_shape", None)
         self.__dict__.setdefault("datum_dtype", None)
         self.__dict__.setdefault("_fingerprint", None)
+        self.__dict__.setdefault("_segment_plan", None)
