@@ -4139,6 +4139,288 @@ def bench_distributed_trace() -> dict:
     }
 
 
+def bench_hot_wire() -> dict:
+    """Hot wire path (cluster/codec.py + shm.py + front-door
+    coalescing): the serving tier's transport with pickle taken off the
+    hot loop — binary frames, same-host shared-memory payload slots,
+    and multi-member coalesced frames priced by the learned service
+    estimate.
+
+    The workload is transport-bound BY DESIGN: a callback-free wide
+    matmul (768 KB float32 per request datum, 16-float replies) where
+    moving the datum router -> worker dominates per-request cost —
+    exactly the regime the hot path exists for. ``hot`` is the DEFAULT
+    configuration (binary codec + coalescing + shm rings); ``pickle``
+    is the KEYSTONE_WIRE_CODEC=pickle kill switch with coalescing off —
+    the pre-hot-wire wire discipline.
+
+    Gates:
+      * throughput_2x_ok — hot sustains >= 2x pickle's closed-loop
+        requests/sec on the same 2-worker fleet at equal-or-better p99
+        (best-of-2 trials per mode, interleaved against box drift);
+      * wire_share_shrinks_ok — single-flight traced requests in both
+        modes: the wire hop's share of the stitched hop sum (send
+        transport + reply transport over admission + wire + worker
+        queue + replica batch) shrinks under the hot path;
+      * bit_equal_ok — the measured loops' replies are bit-identical
+        across codecs (np.array_equal over the stacked outputs): the
+        binary codec is a transport, not a rounding step;
+      * kill_zero_failures_ok — SIGSTOP a worker so its share of a
+        96-request burst piles up in coalesced frames, then SIGKILL
+        it: every admitted request still answers with ITS result
+        (member-level requeue preserves identity), requeues > 0, and
+        the worker respawns.
+    """
+    import os
+    import signal
+    import statistics
+    from collections import defaultdict
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from keystone_tpu.cluster import ClusterRouter
+    from keystone_tpu.obs import tracer as trace_mod
+
+    d = 196_608  # 768 KB float32 per request datum
+    buckets = (16,)
+    spec = (
+        "factory", "keystone_tpu.cluster.demo:build_wide_model",
+        {"d": d},
+    )
+    rng = np.random.RandomState(7)
+    data = rng.randn(64, d).astype(np.float32)
+
+    MODES = {
+        "hot": {},  # the defaults ARE the hot path
+        "pickle": {"wire_codec": "pickle", "coalesce": False},
+    }
+
+    def make_router(mode, **kw):
+        return ClusterRouter(
+            spec, workers=2, replicas_per_worker=1, buckets=buckets,
+            datum_shape=(d,), max_wait_ms=2.0, max_queue=8192,
+            spawn_timeout_s=300, **MODES[mode], **kw,
+        )
+
+    def closed_loop(mode, n_requests=512, clients=64):
+        with make_router(mode) as r:
+            with ThreadPoolExecutor(max_workers=clients) as pool:
+                list(pool.map(  # prime off the clock (bucket traces)
+                    lambda i: r.predict(data[i % len(data)]),
+                    range(4 * 2 * buckets[0]),
+                ))
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=clients) as pool:
+                outs = list(pool.map(
+                    lambda i: np.asarray(r.predict(data[i % len(data)])),
+                    range(n_requests),
+                ))
+            wall = time.perf_counter() - t0
+            snap = r.snapshot()
+        return n_requests / wall, snap, outs
+
+    # payloads must actually ride the rings: enough slots that a
+    # 64-client burst of 768 KB payloads rarely degrades inline (the
+    # fallback counter reports whatever still does)
+    prev_slots = os.environ.get("KEYSTONE_SHM_SLOTS")
+    os.environ["KEYSTONE_SHM_SLOTS"] = "32"
+    prev_tracer = trace_mod.stop()
+    try:
+        # -- gates (a) + (c): throughput best-of-2, bit-equal replies ----
+        best = {m: (0.0, None, None) for m in MODES}
+        for _ in range(2):
+            for mode in ("pickle", "hot"):
+                thr, snap, outs = closed_loop(mode)
+                if thr > best[mode][0]:
+                    best[mode] = (thr, snap, outs)
+        thr_pickle, snap_pickle, outs_pickle = best["pickle"]
+        thr_hot, snap_hot, outs_hot = best["hot"]
+        p99_pickle = snap_pickle["latency"].get("p99", float("inf"))
+        p99_hot = snap_hot["latency"].get("p99", float("inf"))
+        bit_equal = bool(
+            np.array_equal(np.stack(outs_pickle), np.stack(outs_hot))
+        )
+
+        # -- gate (b): wire hop share of the stitched trace shrinks ------
+        def traced_wire_share(mode, n_traced=16):
+            from keystone_tpu.obs.context import Sampler
+
+            trace_mod.install(trace_mod.Tracer())
+            try:
+                with make_router(mode) as r:
+                    # primer runs UNSAMPLED: cold-path hops (first-batch
+                    # bucket traces) never enter the measured population
+                    r._sampler = Sampler(0.0)
+                    for i in range(16):
+                        r.predict(data[i % len(data)], timeout=60.0)
+                    r._sampler = Sampler(1.0)
+                    for i in range(n_traced):  # single-flight: clean rows
+                        r.predict(data[i % len(data)], timeout=60.0)
+                    span_sets = r.collect_trace(timeout=10.0)
+            finally:
+                trace_mod.stop()
+            by_trace = defaultdict(dict)
+            for spans in span_sets:
+                for s in spans:
+                    tid = (s.get("args") or {}).get("trace_id")
+                    if tid:
+                        by_trace[tid][s["name"]] = s
+            need = {
+                "rpc.admission", "rpc.request", "cluster.handle",
+                "serve.queue", "serve.replica",
+            }
+            wires, sums = [], []
+            for spans in by_trace.values():
+                if set(spans) < need:
+                    continue  # a hop's stats reply raced the collection
+                # transport_s is stamped before the router encodes the
+                # frame, so it already contains serialize + send (same
+                # accounting as distributed_trace's hop_sum gate)
+                wire = (
+                    (spans["cluster.handle"]["args"].get("transport_s")
+                     or 0)
+                    + (spans["rpc.request"]["args"].get(
+                        "reply_transport_s") or 0)
+                )
+                wires.append(wire)
+                sums.append(
+                    spans["rpc.admission"]["dur_s"] + wire
+                    + spans["serve.queue"]["dur_s"]
+                    + spans["serve.replica"]["dur_s"]
+                )
+            med_wire = statistics.median(wires) if wires else 0.0
+            med_sum = statistics.median(sums) if sums else 0.0
+            return {
+                "traced": len(sums),
+                "wire_median_s": round(med_wire, 5),
+                "hop_sum_median_s": round(med_sum, 5),
+                "wire_share": round(med_wire / max(med_sum, 1e-9), 3),
+            }
+
+        share_pickle = traced_wire_share("pickle")
+        share_hot = traced_wire_share("hot")
+
+        # -- gate (d): SIGSTOP -> SIGKILL with coalesced frames in flight
+        from keystone_tpu.cluster.demo import build_wide_model
+
+        expected = np.asarray(
+            build_wide_model(d=d).apply(data).to_array()
+        )
+        n_kill = 96
+        failures = 0
+        outs_kill = []
+        with make_router("hot", max_restarts=2) as r:
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                list(pool.map(  # warm both workers + the estimate
+                    lambda i: r.predict(data[i % len(data)]),
+                    range(4 * buckets[0]),
+                ))
+            victim = r.worker_pids[0]
+            # SIGSTOP first: the victim's share of the burst piles up
+            # outstanding (it can neither answer nor close its socket),
+            # so the SIGKILL is GUARANTEED to strand coalesced members
+            os.kill(victim, signal.SIGSTOP)
+            try:
+                with ThreadPoolExecutor(max_workers=24) as pool:
+
+                    def one(i):
+                        return np.asarray(
+                            r.predict(data[i % len(data)], timeout=120.0)
+                        )
+
+                    futs = [pool.submit(one, i) for i in range(n_kill)]
+                    time.sleep(0.5)  # frames land on the stopped victim
+                    os.kill(victim, signal.SIGKILL)
+                    for i, f in enumerate(futs):
+                        try:
+                            outs_kill.append((i, f.result(timeout=120)))
+                        except Exception:
+                            failures += 1
+            finally:
+                try:
+                    os.kill(victim, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            answered_right = sum(
+                1 for i, out in outs_kill
+                if np.allclose(out, expected[i % len(data)], atol=1e-4)
+            )
+            deadline = time.monotonic() + 120
+            while r.live_workers < 2 and time.monotonic() < deadline:
+                time.sleep(0.25)
+            kill_snap = r.snapshot()
+            respawned = r.live_workers
+    finally:
+        if prev_slots is None:
+            os.environ.pop("KEYSTONE_SHM_SLOTS", None)
+        else:
+            os.environ["KEYSTONE_SHM_SLOTS"] = prev_slots
+        if prev_tracer is not None:
+            trace_mod.install(prev_tracer)
+
+    ch = snap_hot["counters"]
+    cp = snap_pickle["counters"]
+    ck = kill_snap["counters"]
+    return {
+        "pipeline": f"tanh({d}x16 matmul), 768KB/request datum",
+        "buckets": list(buckets),
+        "closed_loop_requests": 512,
+        "pickle": {
+            "throughput_rps": round(thr_pickle, 1),
+            "p99_s": round(p99_pickle, 4),
+            "req_frames": cp.get("wire.frames.req", 0),
+            "req_bytes": cp.get("wire.bytes_sent.req", 0),
+        },
+        "hot": {
+            "throughput_rps": round(thr_hot, 1),
+            "p99_s": round(p99_hot, 4),
+            "req_frames": ch.get("wire.frames.req", 0),
+            "req_bytes": ch.get("wire.bytes_sent.req", 0),
+            "coalesced_frames": ch.get("coalesce.frames", 0),
+            "coalesced_members": ch.get("coalesce.members", 0),
+            "shm_payloads": ch.get("shm.payloads", 0),
+            "shm_fallback_inline": ch.get("shm.fallback", 0),
+        },
+        "speedup_hot_vs_pickle": round(thr_hot / max(thr_pickle, 1e-9), 2),
+        "wire_hop_share": {"pickle": share_pickle, "hot": share_hot},
+        "worker_kill": {
+            "requests": n_kill,
+            "failures": failures,
+            "answered_with_own_result": answered_right,
+            "requeues": ck.get("requeues", 0),
+            "restarts": ck.get("restarts", 0),
+            "coalesced_frames": ck.get("coalesce.frames", 0),
+            "live_workers_after": respawned,
+        },
+        "throughput_2x_ok": bool(
+            thr_hot >= 2.0 * thr_pickle and p99_hot <= 1.05 * p99_pickle
+        ),
+        "wire_share_shrinks_ok": bool(
+            share_pickle["traced"] >= 8
+            and share_hot["traced"] >= 8
+            and share_hot["wire_share"] < share_pickle["wire_share"]
+        ),
+        "bit_equal_ok": bit_equal,
+        "kill_zero_failures_ok": bool(
+            failures == 0
+            and answered_right == n_kill
+            and ck.get("requeues", 0) > 0
+            and ck.get("restarts", 0) >= 1
+            and ck.get("coalesce.frames", 0) > 0
+            and respawned == 2
+        ),
+        "knobs": (
+            "KEYSTONE_WIRE_CODEC=pickle reverts the binary codec; "
+            "KEYSTONE_WIRE_SHM=0 keeps frames inline; KEYSTONE_COALESCE=0 "
+            "dispatches frame-per-request; KEYSTONE_SHM_SLOTS / "
+            "KEYSTONE_SHM_SLOT_BYTES / KEYSTONE_SHM_MIN_BYTES size the "
+            "rings; ClusterRouter(wire_codec=, wire_shm=, coalesce=) "
+            "override per router"
+        ),
+    }
+
+
 def bench_autoscale_qos() -> dict:
     """Autoscaling + QoS (keystone_tpu/autoscale/): an elastic
     ClusterRouter under a bursty two-tenant ~3x overload, against the
@@ -4775,6 +5057,7 @@ def main() -> int:
     distributed_trace = _section(
         "distributed_trace", bench_distributed_trace
     )
+    hot_wire = _section("hot_wire", bench_hot_wire)
     autoscale_qos = _section("autoscale_qos", bench_autoscale_qos)
     resource_accounting = _section(
         "resource_accounting", bench_resource_accounting
@@ -4829,6 +5112,7 @@ def main() -> int:
                     "fault_tolerance": fault_tolerance,
                     "continual_learning": continual_learning,
                     "distributed_trace": distributed_trace,
+                    "hot_wire": hot_wire,
                     "autoscale_qos": autoscale_qos,
                     "resource_accounting": resource_accounting,
                     "trace": trace_extra,

@@ -32,6 +32,12 @@
 # `aot.export`, the warm run must trace `aot.load` and ZERO `aot.export`,
 # and a kill-switched (`KEYSTONE_SEGMENT_COMPILE=0`) run must dispatch
 # strictly MORE node spans than the segment runs did.
+# An eleventh stage (hot wire path) serves a concurrent burst through the
+# router on the binary codec and asserts the coalescer put multiple
+# members on single frames (coalesce.frames < requests answered), the
+# stitched trace carries wire.encode/wire.decode spans, and a second run
+# under the KEYSTONE_WIRE_CODEC=pickle kill switch returns bit-equal
+# outputs.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="${1:-$(mktemp /tmp/keystone-trace-XXXXXX.json)}"
@@ -642,4 +648,68 @@ assert np.array_equal(outs["cold"], outs["nodes"]), "segment vs node outputs dif
 assert np.array_equal(outs["cold"], outs["warm"]), "cold vs warm outputs differ"
 print(f"SEGMENT DISPATCH OK: node spans {counts['nodes']} (node dispatch) -> "
       f"{counts['cold']} (cold) / {counts['warm']} (warm), outputs bit-equal")
+PY
+
+# -- hot wire path: coalescing + binary codec + pickle kill switch ------------
+hw_dir="$(mktemp -d /tmp/keystone-hotwire-smoke-XXXXXX)"
+trap 'rm -rf "$aot_dir" "$prof_dir" "$flight_dir" "$seg_dir" "$hw_dir"' EXIT
+for codec in binary pickle; do
+  hw_out="$(mktemp /tmp/keystone-hotwire-trace-XXXXXX.json)"
+  env JAX_PLATFORMS=cpu KEYSTONE_WIRE_CODEC="$codec" \
+    python - "$hw_out" "$codec" "$hw_dir" <<'PY'
+import json
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from keystone_tpu.cluster import ClusterRouter
+from keystone_tpu.obs import tracer as trace_mod
+
+trace_mod.install(trace_mod.Tracer())
+N = 48
+r = ClusterRouter(
+    ("factory", "keystone_tpu.cluster.demo:build_stall_model",
+     {"d": 32, "stall_s": 0.004}),
+    workers=2, replicas_per_worker=1, buckets=(16,), datum_shape=(32,),
+    max_wait_ms=2.0, spawn_timeout_s=300,
+)
+data = np.random.RandomState(7).randn(N, 32).astype(np.float32)
+with r:
+    with ThreadPoolExecutor(max_workers=N) as pool:
+        outs = list(pool.map(
+            lambda i: np.asarray(r.predict(data[i], timeout=60.0)), range(N)
+        ))
+    snap = r.snapshot()
+    path = r.export_trace(sys.argv[1])
+
+codec = sys.argv[2]
+np.save(f"{sys.argv[3]}/out_{codec}.npy", np.stack(outs))
+c = snap["counters"]
+frames = int(c.get("wire.frames.req", 0))
+co_frames = int(c.get("coalesce.frames", 0))
+co_members = int(c.get("coalesce.members", 0))
+assert frames and frames < N, (
+    f"coalescer sent {frames} req frames for {N} requests"
+)
+assert co_frames >= 1 and co_members > co_frames, c
+assert int(c.get("wire.bytes_sent.req", 0)) > 0, c
+with open(path) as f:
+    doc = json.load(f)
+names = {e["name"] for e in doc["traceEvents"]}
+assert "wire.encode" in names, sorted(names)
+print(f"HOT WIRE OK ({codec}): {N} requests on {frames} req frame(s), "
+      f"{co_members} member(s) coalesced into {co_frames} frame(s)")
+PY
+done
+python - "$hw_dir" <<'PY'
+import sys
+
+import numpy as np
+
+d = sys.argv[1]
+a = np.load(f"{d}/out_binary.npy")
+b = np.load(f"{d}/out_pickle.npy")
+assert np.array_equal(a, b), "binary vs pickle outputs differ"
+print(f"HOT WIRE PARITY OK: {a.shape[0]} outputs bit-equal across codecs")
 PY

@@ -24,6 +24,14 @@ Spark-perf study's driver-bottleneck findings (PAPERS.md #3) call for:
   remaining budget (never extended by the hop), and the serving layer's
   typed errors (``Shed``, ``DeadlineExceeded``, ``QueueFull``, …)
   arrive as the same types on the other side.
+* The hot wire path — compatible admitted requests coalesce into ONE
+  member-list frame (:meth:`ServiceEstimate.coalesce_window` prices the
+  hold), hot frames ride the pickle-free binary codec
+  (:mod:`~keystone_tpu.cluster.codec`, negotiated at handshake,
+  ``KEYSTONE_WIRE_CODEC=pickle`` kills it), and same-host payloads
+  above ``KEYSTONE_SHM_MIN_BYTES`` move zero-copy through
+  :mod:`~keystone_tpu.cluster.shm` rings. See the README's "Hot wire
+  path" subsection.
 
 Sharded chunk PRODUCTION — the training-side half of the same
 host-bottleneck story — lives with the data layer
@@ -34,12 +42,16 @@ the tier; see the README's "Multi-process serving" section for the
 topology and the warm-boot contract.
 """
 
+from .codec import CodecError
 from .router import ClusterRouter, default_workers, format_status
+from .shm import ShmRing
 from .wire import ConnectionClosed, WorkerError
 
 __all__ = [
     "ClusterRouter",
+    "CodecError",
     "ConnectionClosed",
+    "ShmRing",
     "WorkerError",
     "default_workers",
     "format_status",

@@ -64,3 +64,25 @@ def build_stall_model(
         return _jnp.tanh((X * s) @ W)
 
     return FunctionNode(batch_fn=body, label="stall_matmul").to_pipeline().fit()
+
+
+def build_wide_model(d: int = 16384, k: int = 16, seed: int = 7):
+    """The hot-wire bench pipeline: a single small matmul over a WIDE
+    datum and no host callback — per-request cost is then dominated by
+    moving the payload across the process boundary, which is exactly
+    the axis the binary codec + shm ring attack. (The stall model can't
+    play this role: its ``pure_callback`` caps usable batch bytes, and
+    its stall would mask wire time.) Deterministic in ``seed`` for the
+    warm-boot contract, like every factory here."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..workflow.transformer import FunctionNode
+
+    rng = np.random.RandomState(seed)
+    W = jnp.asarray(rng.randn(d, k).astype(np.float32) / np.sqrt(d))
+
+    def body(X):
+        return jnp.tanh(X @ W)
+
+    return FunctionNode(batch_fn=body, label="wide_matmul").to_pipeline().fit()

@@ -68,6 +68,7 @@ import signal
 import socket
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -89,7 +90,12 @@ from ..serving.metrics import MetricsRegistry
 from ..serving.scheduler import ServiceEstimate
 from ..serving.replica import settle_future
 from ..serving.slo import SloPolicy, SloWatchdog
-from ..utils import env_int as _env_int
+from ..utils import (
+    env_flag as _env_flag,
+    env_int as _env_int,
+    env_str as _env_str,
+)
+from . import shm as shm_mod
 from . import wire as wire_mod
 from .wire import (
     ConnectionClosed,
@@ -98,7 +104,6 @@ from .wire import (
     decode_error,
     qos_to_wire,
     recv_msg,
-    send_msg,
 )
 
 logger = logging.getLogger(__name__)
@@ -165,6 +170,15 @@ class _WorkerSlot:
         self.stats_seq = 0
         self.stats_event = threading.Event()
         self.recv_thread: Optional[threading.Thread] = None
+        #: negotiated per connection: binary hot frames only when the
+        #: router wants them AND the worker's hello advertised the codec
+        #: (an old peer keeps pickle — version skew degrades, not breaks)
+        self.codec_binary = False
+        #: same-host zero-copy rings (router→worker tx, worker→router
+        #: rx), generation-named so a respawn gets fresh segments
+        self.shm_tx = None
+        self.shm_rx = None
+        self.shm_gen = 0
         #: worker spans accumulated off stats replies (each worker ships
         #: its fresh spans exactly once, cursor-tracked worker-side) —
         #: what export_trace stitches into cross-process tracks. Kept
@@ -209,6 +223,9 @@ class ClusterRouter:
         autoscale: Optional[ScalePolicy] = None,
         tenant_weights: Optional[Dict[str, float]] = None,
         metrics_port: Optional[int] = None,
+        wire_codec: Optional[str] = None,
+        wire_shm: Optional[bool] = None,
+        coalesce: Optional[bool] = None,
     ):
         self._n = workers if workers is not None else default_workers()
         if self._n < 1:
@@ -233,6 +250,45 @@ class ClusterRouter:
                 dict(tenant_weights) if tenant_weights else None
             ),
         }
+        # hot-wire negotiation knobs: the codec the router WANTS (the
+        # worker's hello must still advertise it — version skew keeps
+        # pickle), whether same-host shm rings are offered, and whether
+        # the front door coalesces compatible requests into one frame.
+        # KEYSTONE_WIRE_CODEC=pickle is the kill switch for all three
+        # hot-path layers at once (shm and member framing only ride the
+        # binary codec).
+        codec = (
+            wire_codec if wire_codec is not None
+            else _env_str("KEYSTONE_WIRE_CODEC", "binary")
+        )
+        self._codec = (
+            "pickle" if str(codec).lower() == "pickle" else "binary"
+        )
+        self._spec["wire"] = {"codec": self._codec}
+        self._shm_enabled = self._codec == "binary" and (
+            wire_shm if wire_shm is not None
+            else _env_flag("KEYSTONE_WIRE_SHM", True)
+        )
+        self._shm_slots = _env_int("KEYSTONE_SHM_SLOTS", 8, minimum=1)
+        self._shm_slot_bytes = _env_int(
+            "KEYSTONE_SHM_SLOT_BYTES", 1 << 20, minimum=1024
+        )
+        self._shm_min_bytes = _env_int(
+            "KEYSTONE_SHM_MIN_BYTES", 1 << 16, minimum=1
+        )
+        self._coalesce = (
+            coalesce if coalesce is not None
+            else _env_flag("KEYSTONE_COALESCE", True)
+        )
+        #: members per coalesced frame: the largest bucket (one full
+        #: worker batch) unless KEYSTONE_COALESCE_MAX overrides
+        cap = _env_int("KEYSTONE_COALESCE_MAX", 0, minimum=0)
+        self._coalesce_cap = cap or max(
+            int(b) for b in (tuple(buckets) or (1,))
+        )
+        #: the operator ceiling on the coalesce hold (the same knob the
+        #: worker scheduler's batch window uses), in seconds
+        self._max_coalesce_wait_s = float(max_wait_ms) / 1e3
         self._metrics = metrics or MetricsRegistry(name="cluster-router")
         self._max_queue = int(max_queue)
         self._max_restarts = int(max_restarts)
@@ -247,6 +303,11 @@ class ClusterRouter:
         self._slots = [_WorkerSlot(i) for i in range(self._n)]
         self._pending: Dict[int, _PendingReq] = {}
         self._parked: List[_PendingReq] = []
+        #: admitted, not yet placed: the coalescer's intake (admission
+        #: already priced these — the dispatch thread only groups and
+        #: sends, it never re-admits)
+        self._coalesce_q: deque = deque()
+        self._dispatch_thread: Optional[threading.Thread] = None
         self._req_ids = itertools.count()
         self._token = secrets.token_hex(16)
         self._listener: Optional[socket.socket] = None
@@ -304,7 +365,10 @@ class ClusterRouter:
             import pickle
 
             try:
-                return ("pickle", pickle.dumps(model, protocol=5))
+                return (
+                    "pickle",
+                    pickle.dumps(model, protocol=5),  # lint: allow-pickle -- boot-path model shipping, never a wire frame
+                )
             except Exception as e:
                 raise ValueError(
                     "this FittedPipeline cannot be pickled to worker "
@@ -331,7 +395,10 @@ class ClusterRouter:
         """Requests admitted and not yet answered — the aggregate queue
         depth the shed pricing divides by fleet capacity."""
         with self._lock:
-            return len(self._pending) + len(self._parked)
+            return (
+                len(self._pending) + len(self._parked)
+                + len(self._coalesce_q)
+            )
 
     @property
     def capacity(self) -> int:
@@ -443,6 +510,12 @@ class ClusterRouter:
             target=self._health_loop, name="ks-router-health", daemon=True
         )
         self._health_thread.start()
+        if self._coalesce:
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop,
+                name="ks-router-dispatch", daemon=True,
+            )
+            self._dispatch_thread.start()
         if self._metrics_port is not None:
             # the scrape plane serves the MERGED fleet snapshot the
             # router already computes: a scrape is one stats round-trip,
@@ -496,9 +569,27 @@ class ClusterRouter:
         if slot.index >= int(spec.get("n_workers") or 1):
             spec = dict(spec)
             spec["n_workers"] = slot.index + 1
+        if self._shm_enabled:
+            # fresh generation-named segments per spawn: slots a dead
+            # incarnation held can never leak into the new one
+            self._release_rings(slot)
+            slot.shm_gen += 1
+            base = f"ks{os.getpid():x}w{slot.index}g{slot.shm_gen}"
+            tx, rx = shm_mod.make_ring_pair(
+                base, self._shm_slots, self._shm_slot_bytes
+            )
+            slot.shm_tx, slot.shm_rx = tx, rx
+            if tx is not None:
+                spec = dict(spec)
+                spec["shm"] = {
+                    "c2w": tx.name,
+                    "w2c": rx.name,
+                    "slots": self._shm_slots,
+                    "slot_bytes": self._shm_slot_bytes,
+                }
         try:
             proc.stdin.write(
-                pickle.dumps(spec, protocol=pickle.HIGHEST_PROTOCOL)
+                pickle.dumps(spec, protocol=pickle.HIGHEST_PROTOCOL)  # lint: allow-pickle -- boot spec over stdin, not a wire frame
             )
             proc.stdin.close()
         except BrokenPipeError:
@@ -507,6 +598,17 @@ class ClusterRouter:
         logger.info(
             "cluster: spawned worker %d (pid %s)", slot.index, proc.pid
         )
+
+    def _release_rings(self, slot: _WorkerSlot) -> None:
+        """Close + unlink a slot's shm rings (idempotent). Called on
+        every death/retire path AND before a respawn's fresh pair — the
+        router owns ring lifetime, the worker only attaches."""
+        tx, rx = slot.shm_tx, slot.shm_rx
+        slot.shm_tx = slot.shm_rx = None
+        for ring in (tx, rx):
+            if ring is not None:
+                ring.close()
+                ring.unlink()
 
     def _accept_loop(self) -> None:
         """Match incoming worker connections (hello + ready, token
@@ -550,9 +652,11 @@ class ClusterRouter:
                 except OSError:
                     pass
                 continue
-            self._register_ready(int(hello["worker"]), conn, ready)
+            self._register_ready(int(hello["worker"]), conn, hello, ready)
 
-    def _register_ready(self, index: int, conn, ready: dict) -> None:
+    def _register_ready(
+        self, index: int, conn, hello: dict, ready: dict
+    ) -> None:
         slot = self._slots[index]
         with self._cond:
             if slot.retired:
@@ -570,6 +674,24 @@ class ClusterRouter:
             slot.capacity = int(ready.get("capacity", 1))
             slot.ready_report = dict(ready)
             slot.outstanding = set()
+            # codec negotiation: binary only when this router wants it
+            # AND the hello advertised it — an old worker that never
+            # heard of the codec keeps receiving pickle frames
+            try:
+                peer_codec = int(hello.get("codec") or 0)
+            except (TypeError, ValueError):
+                peer_codec = 0
+            slot.codec_binary = self._codec == "binary" and peer_codec >= 1
+            # shm negotiation closes on the ready report: a worker that
+            # could not attach (or predates the rings) answers without
+            # shm=true and the router tears the segments down — payloads
+            # stay inline, nothing leaks
+            if slot.shm_tx is not None and not ready.get("shm"):
+                logger.info(
+                    "cluster: worker %d did not attach shared-memory "
+                    "rings — payloads stay inline", index,
+                )
+                self._release_rings(slot)
             slot.recv_thread = threading.Thread(
                 target=self._recv_loop, args=(slot, conn),
                 name=f"ks-router-recv-{index}", daemon=True,
@@ -591,10 +713,44 @@ class ClusterRouter:
     def _recv_loop(self, slot: _WorkerSlot, conn) -> None:
         try:
             while True:
-                msg = recv_msg(conn)
+                payload = wire_mod.recv_payload(conn)
+                t_dec0 = time.perf_counter()
+                # copy=True: decoded values must survive the shm slot's
+                # reclamation (the worker reuses it for the next reply),
+                # so anything slot-backed is copied out and freed HERE —
+                # user-visible results never alias reusable memory
+                msg = wire_mod.decode_payload(
+                    payload, shm=slot.shm_rx, copy=True
+                )
+                t_dec1 = time.perf_counter()
                 kind = msg.get("type")
                 if kind == "res":
-                    self._on_response(slot, msg)
+                    members = msg.get("members")
+                    if members is None:
+                        members = [msg]  # legacy single-request reply
+                    t_unix = msg.get("t_unix")
+                    traced_id = None
+                    for member in members:
+                        tid = self._settle_member(slot, member, t_unix)
+                        if traced_id is None:
+                            traced_id = tid
+                    if traced_id is not None:
+                        tracer = _trace_current()
+                        if tracer is not None:
+                            tracer.record_complete(Span(
+                                name="wire.decode", start=t_dec0,
+                                end=t_dec1, op_type="ClusterRouter",
+                                attrs={
+                                    "trace_id": traced_id,
+                                    "codec": (
+                                        "pickle"
+                                        if payload[:1] == b"\x80"
+                                        else "binary"
+                                    ),
+                                    "bytes": len(payload),
+                                    "members": len(members),
+                                },
+                            ))
                 elif kind == "pong":
                     with self._lock:
                         est = msg.get("service_estimate")
@@ -640,7 +796,16 @@ class ClusterRouter:
                 slot, ConnectionClosed("receive loop failed")
             )
 
-    def _on_response(self, slot: _WorkerSlot, msg: dict) -> None:
+    def _settle_member(
+        self,
+        slot: _WorkerSlot,
+        msg: dict,
+        frame_t_unix: Optional[float] = None,
+    ) -> Optional[str]:
+        """Settle ONE answered member (coalesced frames carry several;
+        legacy replies are a one-member frame). Returns the member's
+        trace_id when it was traced — the caller hangs the frame-level
+        wire.decode span off the first one."""
         req_id = msg.get("id")
         with self._lock:
             req = self._pending.pop(req_id, None)
@@ -648,7 +813,7 @@ class ClusterRouter:
                 slot.outstanding.discard(req_id)
             self._cond.notify_all()
         if req is None:
-            return  # already settled (requeue raced a late answer)
+            return None  # already settled (requeue raced a late answer)
         latency = time.monotonic() - req.enqueued
         ok = bool(msg.get("ok"))
         # the always-on flight ring: every answered request leaves a
@@ -661,7 +826,7 @@ class ClusterRouter:
             tracer = _trace_current()
             if tracer is not None:
                 end_pc = time.perf_counter()
-                reply_unix = msg.get("t_unix")
+                reply_unix = msg.get("t_unix", frame_t_unix)
                 tracer.record_complete(Span(
                     name="rpc.request",
                     start=req.t_submit_pc,
@@ -691,6 +856,7 @@ class ClusterRouter:
             if not isinstance(exc, Shed):
                 self._metrics.inc("worker_errors")
             settle_future(req.future, exc)
+        return req.trace.trace_id if req.trace is not None else None
 
     # -- worker failure --------------------------------------------------
 
@@ -705,6 +871,9 @@ class ClusterRouter:
             except OSError:
                 pass
             slot.sock = None
+            # a dead peer's mappings die with it: tear the rings down
+            # (a respawn creates a fresh generation pair)
+            self._release_rings(slot)
             orphans = [
                 self._pending.pop(rid)
                 for rid in sorted(slot.outstanding)
@@ -821,7 +990,10 @@ class ClusterRouter:
                     "submit() needs a started router (call start() or "
                     "use the context manager)"
                 )
-            depth = len(self._pending) + len(self._parked)
+            depth = (
+                len(self._pending) + len(self._parked)
+                + len(self._coalesce_q)
+            )
             if depth >= self._max_queue:
                 self._metrics.inc("rejected")
                 raise QueueFull(
@@ -860,6 +1032,14 @@ class ClusterRouter:
                     trace_id=new_trace_id(next(self._trace_seq)),
                     hop="rpc.request",
                 )
+            if self._coalesce:
+                # hand off to the coalescer: compatible neighbors already
+                # waiting (or arriving within the priced window) share
+                # one wire frame. Admission is done — the dispatch thread
+                # only groups and places.
+                self._coalesce_q.append(req)
+                self._cond.notify_all()
+                return req.future
         self._route(req)
         return req.future
 
@@ -867,37 +1047,135 @@ class ClusterRouter:
         return self.submit(datum, timeout=timeout).result()
 
     def _route(self, req: _PendingReq, from_requeue: bool = False) -> bool:
-        """Place ``req`` on the least-outstanding live worker and send
-        it. Returns True when it was handed to a worker (or parked for a
-        restarting one); settles the future typed otherwise."""
+        """Single-request dispatch (requeues, parked flushes, and the
+        ``coalesce=False`` spelling) — one member, no coalesce wait."""
+        return self._dispatch([req], from_requeue=from_requeue)
+
+    @staticmethod
+    def _compat_key(req: _PendingReq) -> tuple:
+        """Requests that may share a wire frame: same priority class and
+        the same bucket signature (shape + dtype — what the worker's
+        bucket ladder pads against). The model digest needs no key
+        component: one router serves one model."""
+        d = req.datum
+        return (
+            req.priority,
+            tuple(getattr(d, "shape", ()) or ()),
+            str(getattr(d, "dtype", type(d).__name__)),
+        )
+
+    def _drain_compatible(self, batch: list, key: tuple, cap: int) -> None:
+        """Move every queued compatible request into ``batch`` (up to
+        ``cap``), preserving queue order for the rest. Lock held."""
+        if len(batch) >= cap or not self._coalesce_q:
+            return
+        kept: deque = deque()
+        while self._coalesce_q and len(batch) < cap:
+            r = self._coalesce_q.popleft()
+            if self._compat_key(r) == key:
+                batch.append(r)
+            else:
+                kept.append(r)
+        kept.extend(self._coalesce_q)
+        self._coalesce_q = kept
+
+    def _dispatch_loop(self) -> None:
+        """The coalescer: pop the queue head, drain everything
+        compatible, and — only for a PARTIAL batch with nothing else
+        waiting — hold the frame open for the priced window
+        (:meth:`ServiceEstimate.coalesce_window`: a fraction of one
+        learned batch-service time, capped by the operator's max-wait
+        and the tightest member deadline; zero while cold). A lone
+        request with an empty queue dispatches immediately, and any
+        incompatible arrival closes the window early — coalescing never
+        buys head-of-line blocking."""
+        while True:
+            with self._cond:
+                while not self._coalesce_q and not self._closed:
+                    self._cond.wait(timeout=0.5)
+                if self._closed:
+                    return  # shutdown flushed/swept the queue
+                batch = [self._coalesce_q.popleft()]
+                key = self._compat_key(batch[0])
+                cap = self._coalesce_cap
+                self._drain_compatible(batch, key, cap)
+                if 1 < len(batch) < cap and not self._coalesce_q:
+                    now = time.monotonic()
+                    tightest = min(
+                        (
+                            r.deadline for r in batch
+                            if r.deadline is not None
+                        ),
+                        default=None,
+                    )
+                    until = now + self._service.coalesce_window(
+                        now, tightest, cap=self._max_coalesce_wait_s
+                    )
+                    while len(batch) < cap and not self._closed:
+                        remaining = until - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                        self._drain_compatible(batch, key, cap)
+                        if self._coalesce_q:
+                            break  # other traffic waits for no window
+            self._dispatch(batch)
+
+    def _dispatch(
+        self,
+        reqs: List[_PendingReq],
+        from_requeue: bool = False,
+        during_shutdown: bool = False,
+    ) -> bool:
+        """Place a compatible group on the least-outstanding live worker
+        and send it as ONE wire frame; every member keeps its own
+        pending entry (and so its own identity through requeues — a
+        worker death mid-frame re-places members individually). Returns
+        True when the group was handed to a worker (or parked); settles
+        futures typed otherwise."""
+        reqs = [r for r in reqs if not r.future.done()]
+        if not reqs:
+            return True
         while True:
             with self._lock:
-                if self._closed:
-                    settle_future(
-                        req.future,
-                        EngineStopped("router shut down before dispatch"),
-                    )
-                    return False
-                if from_requeue and req.deadline is not None:
-                    cap = sum(
-                        s.capacity for s in self._slots
-                        if s.alive and not s.draining
-                    )
-                    est = (
-                        self._service.wait(len(self._pending), cap)
-                        * SHED_BIAS[req.priority]
-                    )
-                    if time.monotonic() + est > req.deadline:
-                        self._metrics.inc("shed")
-                        self._metrics.inc(f"shed.{req.priority}")
+                if self._closed and not during_shutdown:
+                    for r in reqs:
                         settle_future(
-                            req.future,
-                            Shed(
-                                "deadline unmeetable after worker "
-                                f"failure: estimated wait {est:.4f}s "
-                                "exceeds the remaining budget"
+                            r.future,
+                            EngineStopped(
+                                "router shut down before dispatch"
                             ),
                         )
+                    return False
+                if from_requeue:
+                    survivors = []
+                    for r in reqs:
+                        if r.deadline is None:
+                            survivors.append(r)
+                            continue
+                        cap = sum(
+                            s.capacity for s in self._slots
+                            if s.alive and not s.draining
+                        )
+                        est = (
+                            self._service.wait(len(self._pending), cap)
+                            * SHED_BIAS[r.priority]
+                        )
+                        if time.monotonic() + est > r.deadline:
+                            self._metrics.inc("shed")
+                            self._metrics.inc(f"shed.{r.priority}")
+                            settle_future(
+                                r.future,
+                                Shed(
+                                    "deadline unmeetable after worker "
+                                    f"failure: estimated wait {est:.4f}s "
+                                    "exceeds the remaining budget"
+                                ),
+                            )
+                            continue
+                        survivors.append(r)
+                    reqs = survivors
+                    if not reqs:
                         return False
                 live = [
                     s for s in self._slots if s.alive and not s.draining
@@ -906,68 +1184,128 @@ class ClusterRouter:
                     if any(
                         s.respawning or s.booting for s in self._slots
                     ):
-                        self._parked.append(req)
+                        self._parked.extend(reqs)
                         return True
-                    settle_future(
-                        req.future,
-                        EngineStopped(
-                            "no live workers (restart budget exhausted)"
-                        ),
-                    )
+                    for r in reqs:
+                        settle_future(
+                            r.future,
+                            EngineStopped(
+                                "no live workers (restart budget "
+                                "exhausted)"
+                            ),
+                        )
                     return False
                 slot = min(live, key=lambda s: len(s.outstanding))
-                req_id = next(self._req_ids)
-                self._pending[req_id] = req
-                slot.outstanding.add(req_id)
+                ids = []
+                for r in reqs:
+                    rid = next(self._req_ids)
+                    self._pending[rid] = r
+                    slot.outstanding.add(rid)
+                    ids.append(rid)
             try:
-                msg = {
-                    "type": "req",
-                    "id": req_id,
-                    "datum": req.datum,
-                    "deadline_rem": deadline_to_wire(req.deadline),
-                    **qos_to_wire(req.priority, req.tenant),
-                }
-                tracer = _trace_current() if req.trace is not None else None
-                if req.trace is not None:
-                    # the stamp necessarily precedes pickling (it rides
-                    # the frame), so the receiver's transport_s INCLUDES
-                    # serialize + send — consumers summing hops must use
-                    # transport_s OR the rpc.send span, never both
-                    t_send_pc = time.perf_counter()
-                    msg["trace"] = req.trace.to_wire()
+                members = []
+                for rid, r in zip(ids, reqs):
+                    members.append({
+                        "id": rid,
+                        "datum": r.datum,
+                        "deadline_rem": deadline_to_wire(r.deadline),
+                        **qos_to_wire(r.priority, r.tenant),
+                    })
+                traced = [r for r in reqs if r.trace is not None]
+                tracer = _trace_current() if traced else None
+                # the stamp necessarily precedes encoding (it rides the
+                # frame), so the receiver's transport_s INCLUDES
+                # serialize + send — consumers summing hops must use
+                # transport_s OR the rpc.send span, never both
+                t_send_pc = time.perf_counter()
+                for m, r in zip(members, reqs):
+                    if r.trace is not None:
+                        m["trace"] = r.trace.to_wire()
+                payload = wire_mod.encode_msg(
+                    {"type": "req", "members": members},
+                    codec=(
+                        "binary" if slot.codec_binary else "pickle"
+                    ),
+                    shm=slot.shm_tx,
+                    min_shm_bytes=self._shm_min_bytes,
+                    metrics=self._metrics,
+                )
+                t_enc_pc = time.perf_counter()
                 with slot.send_lock:
-                    send_msg(slot.sock, msg)
+                    wire_mod.send_payload(slot.sock, payload)
+                done_pc = time.perf_counter()
+                self._count_frame("req", len(payload))
+                if len(members) > 1:
+                    self._metrics.inc("coalesce.frames")
+                    self._metrics.inc("coalesce.members", len(members))
                 if tracer is not None:
-                    done_pc = time.perf_counter()
-                    attrs = {
-                        "trace_id": req.trace.trace_id,
-                        "worker": slot.index,
-                        "hops": req.hops,
-                    }
-                    # the admission hop (submit -> send start: front-door
-                    # pricing + placement) and the wire-send hop
-                    # (pickle + sendall), recorded as completed spans —
-                    # the submitting thread cannot hold them open across
-                    # the response's arrival on the recv thread
+                    # the admission hop (submit -> send start:
+                    # front-door pricing + coalescing + placement) and
+                    # the wire-send hop (encode + sendall) per traced
+                    # member, plus ONE nested wire.encode span for the
+                    # frame — recorded completed: the dispatch thread
+                    # cannot hold spans open across the reply
+                    for r in traced:
+                        attrs = {
+                            "trace_id": r.trace.trace_id,
+                            "worker": slot.index,
+                            "hops": r.hops,
+                            "members": len(members),
+                        }
+                        tracer.record_complete(Span(
+                            name="rpc.admission", start=r.t_submit_pc,
+                            end=t_send_pc, op_type="ClusterRouter",
+                            attrs=dict(attrs),
+                        ))
+                        tracer.record_complete(Span(
+                            name="rpc.send", start=t_send_pc,
+                            end=done_pc, op_type="ClusterRouter",
+                            attrs=dict(attrs),
+                        ))
                     tracer.record_complete(Span(
-                        name="rpc.admission", start=req.t_submit_pc,
-                        end=t_send_pc, op_type="ClusterRouter",
-                        attrs=dict(attrs),
-                    ))
-                    tracer.record_complete(Span(
-                        name="rpc.send", start=t_send_pc, end=done_pc,
-                        op_type="ClusterRouter", attrs=dict(attrs),
+                        name="wire.encode", start=t_send_pc,
+                        end=t_enc_pc, op_type="ClusterRouter",
+                        attrs={
+                            "trace_id": traced[0].trace.trace_id,
+                            "codec": (
+                                "binary" if slot.codec_binary
+                                else "pickle"
+                            ),
+                            "bytes": len(payload),
+                            "members": len(members),
+                        },
                     ))
                 return True
             except Exception as e:
-                # the worker died under us: undo the bookkeeping and let
-                # the down-handler (idempotent) run, then try a peer
+                # the worker died under us: undo the bookkeeping for the
+                # whole group and let the down-handler (idempotent) run,
+                # then try a peer with whoever is still unanswered
                 with self._lock:
-                    self._pending.pop(req_id, None)
-                    slot.outstanding.discard(req_id)
+                    for rid in ids:
+                        self._pending.pop(rid, None)
+                        slot.outstanding.discard(rid)
                 self._on_worker_down(
                     slot, ConnectionClosed(f"send failed: {e}")
                 )
+                reqs = [r for r in reqs if not r.future.done()]
+                if not reqs:
+                    return False
+
+    def _count_frame(self, kind: str, nbytes: int) -> None:
+        """Per-kind wire accounting (frames out + payload bytes out) —
+        what the hot-wire bench reads to show the codec shrinking the
+        hop."""
+        self._metrics.inc(f"wire.frames.{kind}")
+        self._metrics.inc(f"wire.bytes_sent.{kind}", nbytes)
+
+    def _send_control(self, slot: _WorkerSlot, msg: dict) -> None:
+        """Send one control frame (always pickle — control dicts carry
+        arbitrary values and never ride the hot path) with per-kind wire
+        accounting. Raises on a dead socket like ``send_msg``."""
+        payload = wire_mod.encode_msg(msg)
+        with slot.send_lock:
+            wire_mod.send_payload(slot.sock, payload)
+        self._count_frame(str(msg.get("type")), len(payload))
 
     # -- health + merged metrics ----------------------------------------
 
@@ -982,10 +1320,9 @@ class ClusterRouter:
                 live = [s for s in self._slots if s.alive]
             for slot in live:
                 try:
-                    with slot.send_lock:
-                        send_msg(slot.sock, {
-                            "type": "ping", "t": time.monotonic(),
-                        })
+                    self._send_control(
+                        slot, {"type": "ping", "t": time.monotonic()}
+                    )
                 except Exception as e:
                     self._on_worker_down(
                         slot, ConnectionClosed(f"ping failed: {e}")
@@ -1179,8 +1516,7 @@ class ClusterRouter:
             timed_out = bool(slot.outstanding) and slot.alive
         if slot.alive and slot.sock is not None:
             try:
-                with slot.send_lock:
-                    send_msg(slot.sock, {"type": "stop", "drain": True})
+                self._send_control(slot, {"type": "stop", "drain": True})
             except Exception:
                 logger.debug(
                     "drain stop to worker %d failed (already dead?)",
@@ -1213,6 +1549,7 @@ class ClusterRouter:
             except OSError:
                 pass
             slot.sock = None
+            self._release_rings(slot)
             self._cond.notify_all()
         _flight.record_instant(
             "scale.drained", worker=slot.index, timed_out=timed_out,
@@ -1237,6 +1574,7 @@ class ClusterRouter:
             slot.retired = True
             slot.alive = False
             sock, slot.sock = slot.sock, None
+            self._release_rings(slot)
             proc = slot.proc
             orphans = [
                 self._pending.pop(rid)
@@ -1294,11 +1632,9 @@ class ClusterRouter:
                 slot.stats_event.clear()
         for slot in live:
             try:
-                with slot.send_lock:
-                    send_msg(
-                        slot.sock,
-                        {"type": "stats", "seq": slot.stats_seq},
-                    )
+                self._send_control(
+                    slot, {"type": "stats", "seq": slot.stats_seq}
+                )
             except Exception:
                 logger.debug(
                     "stats request to worker %d failed", slot.index,
@@ -1549,10 +1885,20 @@ class ClusterRouter:
             if self._closed:
                 return
             self._closed = True
+            flush: List[_PendingReq] = list(self._coalesce_q)
+            self._coalesce_q = deque()
             self._cond.notify_all()
         exporter, self._exporter = self._exporter, None
         if exporter is not None:
             exporter.stop()
+        if drain and flush:
+            # admitted but not yet placed when the shutdown hit: a
+            # draining shutdown still owes these real answers — dispatch
+            # the tail now (workers are stopped only after the drain
+            # wait), single frames, no coalesce window
+            for req in flush:
+                self._dispatch([req], during_shutdown=True)
+            flush = []
         if drain:
             deadline = time.monotonic() + self._drain_timeout_s
             with self._cond:
@@ -1568,11 +1914,11 @@ class ClusterRouter:
                         break
                     self._cond.wait(timeout=min(0.2, remaining))
         for slot in self._slots:
-            sock = slot.sock
-            if slot.alive and sock is not None:
+            if slot.alive and slot.sock is not None:
                 try:
-                    with slot.send_lock:
-                        send_msg(sock, {"type": "stop", "drain": drain})
+                    self._send_control(
+                        slot, {"type": "stop", "drain": drain}
+                    )
                 except Exception:
                     logger.debug(
                         "stop message to worker %d failed (already dead?)",
@@ -1619,11 +1965,18 @@ class ClusterRouter:
                         slot.index,
                     )
         # the belt-and-braces sweep: every admitted request gets an
-        # answer, typed
+        # answer, typed — including anything a non-draining shutdown
+        # left in the coalesce queue
         with self._lock:
-            remaining = list(self._pending.values()) + self._parked
+            remaining = (
+                list(self._pending.values()) + self._parked
+                + flush + list(self._coalesce_q)
+            )
             self._pending.clear()
             self._parked = []
+            self._coalesce_q = deque()
+            for slot in self._slots:
+                self._release_rings(slot)
         for req in remaining:
             settle_future(
                 req.future, EngineStopped("cluster router is shut down")
@@ -1681,6 +2034,27 @@ def format_status(status: dict) -> str:
             round(lat["p99"], 4) if "p99" in lat else None,
         )
     )
+    wire = {
+        k[len("wire.frames."):]: v for k, v in c.items()
+        if k.startswith("wire.frames.")
+    }
+    if wire:
+        sent = {
+            k[len("wire.bytes_sent."):]: v for k, v in c.items()
+            if k.startswith("wire.bytes_sent.")
+        }
+        lines.append(
+            "  wire: " + " ".join(
+                "{}={}f/{}B".format(kind, n, sent.get(kind, 0))
+                for kind, n in sorted(wire.items())
+            ) + " coalesce_frames={} coalesce_members={} "
+            "shm_payloads={} shm_fallback={}".format(
+                c.get("coalesce.frames", 0),
+                c.get("coalesce.members", 0),
+                c.get("shm.payloads", 0),
+                c.get("shm.fallback", 0),
+            )
+        )
     qos = status.get("qos") or {}
     served = qos.get("tenant_served") or {}
     sheds = qos.get("shed_by_priority") or {}

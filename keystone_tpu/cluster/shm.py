@@ -1,0 +1,220 @@
+"""Same-host zero-copy payload transport: a shared-memory slot ring per
+wire direction.
+
+The binary codec (:mod:`.codec`) removed serialization from the hot
+path; what remains for a large array is the MOVE — sender copies into a
+kernel socket buffer, receiver copies back out. For peers on one host
+that round trip is pure waste: ``multiprocessing.shared_memory`` maps
+the same pages into both processes, so a payload written once is simply
+THERE on the other side. This module is the minimal discipline that
+makes that safe:
+
+* **One ring per direction, single writer.** The router creates BOTH
+  segments for a worker slot (it owns their lifetime — creation before
+  spawn, unlink on death/retire/shutdown) and names them in the worker's
+  spec; the worker attaches and confirms in its ``ready`` report (the
+  negotiation: a worker that cannot attach — exotic platform, /dev/shm
+  mounted noexec — answers ``shm: false`` and the router unlinks and
+  runs inline, no retry loop). The router writes only the
+  router→worker ring; the worker writes only worker→router.
+* **In-segment slot states.** The first ``slots`` bytes are the state
+  table (0 = FREE, 1 = BUSY); the rest is ``slots`` fixed-size payload
+  regions. The WRITER flips FREE→BUSY under its local lock (it is the
+  only allocator); the READER flips BUSY→FREE once the member the slot
+  carried is answered — reply receipt IS the reclamation signal, so no
+  ack traffic exists. A torn write cannot corrupt the protocol: the
+  slot index travels inside the socket frame, which is itself
+  length-framed and typed.
+* **Degradation, counted.** A full ring (or a payload larger than a
+  slot) falls back to inline frame bytes — ``shm.fallback`` counts it,
+  the receiver never knows the difference. Worker death unlinks both
+  segments (a respawn gets FRESH segments under a new generation name:
+  slots a dead peer held never leak into the new incarnation).
+
+Readers hand out zero-copy memoryviews; callers that keep data past the
+slot's free (the router's reply path) copy first — :mod:`.codec` owns
+that contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_FREE = 0
+_BUSY = 1
+
+
+def _unregister_tracker(name: str) -> None:
+    """Detach this process's resource_tracker claim on an ATTACHED
+    segment: before 3.13 the tracker registers attaches too, and would
+    unlink the router-owned segment when the worker exits — exactly the
+    double-unlink this guards."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(f"/{name}", "shared_memory")
+    except Exception:
+        logger.debug(
+            "resource_tracker unregister for %s failed (harmless on "
+            "newer Pythons)", name, exc_info=True,
+        )
+
+
+class ShmRing:
+    """One direction's slot ring over a ``SharedMemory`` segment.
+
+    ``create=True`` (the router) allocates and later :meth:`unlink`\\ s;
+    ``create=False`` (the worker) attaches to the named segment. The
+    writer side calls :meth:`alloc` + :meth:`write`; the reader side
+    :meth:`view` + :meth:`free`."""
+
+    def __init__(
+        self,
+        name: str,
+        slots: int,
+        slot_bytes: int,
+        create: bool = False,
+    ):
+        from multiprocessing import shared_memory
+
+        if slots < 1 or slot_bytes < 1:
+            raise ValueError(
+                f"need at least one slot of at least one byte, got "
+                f"{slots}x{slot_bytes}"
+            )
+        self.name = name
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        self._created = bool(create)
+        size = self.slots + self.slots * self.slot_bytes
+        self._shm = shared_memory.SharedMemory(
+            name=name, create=create, size=size
+        )
+        if create:
+            self._shm.buf[: self.slots] = bytes(self.slots)
+        else:
+            _unregister_tracker(self._shm.name)
+        #: serializes this PROCESS's concurrent allocators; cross-process
+        #: safety needs no lock — each state byte has exactly one writer
+        #: per transition direction (writer FREE→BUSY, reader BUSY→FREE)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _data_off(self, slot: int) -> int:
+        return self.slots + slot * self.slot_bytes
+
+    # -- writer side -----------------------------------------------------
+
+    def alloc(self, nbytes: int) -> Optional[int]:
+        """A FREE slot marked BUSY for ``nbytes`` of payload, or None
+        (payload too large for any slot, ring exhausted, ring closed) —
+        the caller degrades to inline bytes."""
+        if nbytes > self.slot_bytes:
+            return None
+        with self._lock:
+            if self._closed:
+                return None
+            buf = self._shm.buf
+            for slot in range(self.slots):
+                if buf[slot] == _FREE:
+                    buf[slot] = _BUSY
+                    return slot
+        return None
+
+    def write(self, slot: int, data) -> None:
+        """Payload bytes into an :meth:`alloc`'d slot (the one memcpy
+        this transport pays — into shared pages instead of the kernel)."""
+        off = self._data_off(slot)
+        n = len(data) if not isinstance(data, memoryview) else data.nbytes
+        self._shm.buf[off: off + n] = data
+
+    # -- reader side -----------------------------------------------------
+
+    def view(self, slot: int, nbytes: int) -> memoryview:
+        """Zero-copy read view of a slot's payload. The slot stays BUSY
+        until :meth:`free` — callers keeping the data longer copy it."""
+        if not (0 <= slot < self.slots) or nbytes > self.slot_bytes:
+            from .codec import CodecError
+
+            raise CodecError(
+                f"shm descriptor out of range: slot {slot} ({nbytes} "
+                f"byte(s)) in a {self.slots}x{self.slot_bytes} ring"
+            )
+        off = self._data_off(slot)
+        return self._shm.buf[off: off + nbytes]
+
+    def free(self, slot: int) -> None:
+        """Reclaim a slot (reader side, after its member is answered)."""
+        if 0 <= slot < self.slots and not self._closed:
+            self._shm.buf[slot] = _FREE
+
+    @property
+    def in_use(self) -> int:
+        return sum(
+            1 for s in range(self.slots) if self._shm.buf[s] == _BUSY
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        """Drop this process's mapping (both sides, idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._shm.close()
+        except BufferError:
+            # a decoded view still aliases the buffer (e.g. an in-flight
+            # request's datum) — the mapping lives until the view dies;
+            # unlink below still removes the name
+            logger.debug(
+                "shm ring %s close deferred: exported views still alive",
+                self.name,
+            )
+        except OSError:
+            logger.debug(
+                "shm ring %s close failed", self.name, exc_info=True
+            )
+
+    def unlink(self) -> None:
+        """Remove the segment name (creator side — after this, only
+        existing mappings keep the pages alive). Idempotent."""
+        if not self._created:
+            return
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+        except OSError:
+            logger.debug(
+                "shm ring %s unlink failed", self.name, exc_info=True
+            )
+
+
+def make_ring_pair(base: str, slots: int, slot_bytes: int):
+    """The router's creation helper: ``(c2w, w2c)`` rings under
+    ``<base>c`` / ``<base>r``, or ``(None, None)`` when the platform
+    refuses shared memory (the negotiation then settles on inline)."""
+    try:
+        c2w = ShmRing(base + "c", slots, slot_bytes, create=True)
+    except Exception:
+        logger.warning(
+            "shared-memory ring %sc unavailable — wire payloads stay "
+            "inline", base, exc_info=True,
+        )
+        return None, None
+    try:
+        w2c = ShmRing(base + "r", slots, slot_bytes, create=True)
+    except Exception:
+        logger.warning(
+            "shared-memory ring %sr unavailable — wire payloads stay "
+            "inline", base, exc_info=True,
+        )
+        c2w.close()
+        c2w.unlink()
+        return None, None
+    return c2w, w2c

@@ -1,5 +1,5 @@
-"""The cluster wire protocol: length-framed pickled messages over a
-local socket, with deadlines and typed errors that survive the process
+"""The cluster wire protocol: length-framed messages over a local
+socket, with deadlines and typed errors that survive the process
 boundary.
 
 Deliberately minimal — the router and its workers share one machine (a
@@ -7,12 +7,20 @@ host driving one accelerator slice), so the protocol optimizes for
 correctness of the THREE things that must not be lost crossing a
 process boundary:
 
-* **Framing.** Every message is ``>I`` length prefix + pickle payload.
-  ``send_msg`` holds the caller's per-connection lock (sockets
-  interleave concurrent sends otherwise); ``recv_msg`` reads exactly
-  one frame or raises :class:`ConnectionClosed` on EOF — a half-read
-  frame (peer died mid-send) is indistinguishable from death and is
-  treated as it.
+* **Framing.** Every message is a ``>I`` length prefix + payload. The
+  payload self-describes its encoding by first byte: hot ``req``/``res``
+  frames ride the binary codec (:mod:`.codec` — fixed header + ndarray
+  descriptors + raw bytes, :data:`~keystone_tpu.cluster.codec.MAGIC`
+  leading), while CONTROL frames (hello/ready/ping/stats/stop/errors)
+  stay pickle (protocol >= 2 payloads always lead with ``0x80``, so the
+  receiver dispatches per frame and old peers interop).
+  ``send_payload``/``send_msg`` hold the caller's per-connection lock
+  (sockets interleave concurrent sends otherwise); ``recv_payload``/
+  ``recv_msg`` read exactly one frame or raise :class:`ConnectionClosed`
+  on EOF — a half-read frame (peer died mid-send) is indistinguishable
+  from death and is treated as it. A malformed BINARY frame degrades
+  typed too (:class:`~keystone_tpu.cluster.codec.CodecError`): hot-path
+  bytes are never handed to ``pickle.loads`` on a parse failure.
 * **Deadlines.** ``time.monotonic()`` is process-local, so absolute
   deadlines are meaningless on the wire. A request's deadline travels
   as its REMAINING budget (seconds), stamped at send time and
@@ -28,8 +36,9 @@ process boundary:
   pickle of an arbitrary exception object (which may not unpickle, or
   may execute reduction code we don't control).
 
-Message payloads are plain dicts with a ``"type"`` key; numpy arrays
-pickle efficiently enough for a localhost hop (protocol 5).
+Message payloads are plain dicts with a ``"type"`` key; both codecs
+round-trip the same dicts, so ``KEYSTONE_WIRE_CODEC=pickle`` is a
+frame-for-frame kill switch, not a different protocol.
 
 **Trace propagation.** A sampled request's ``req`` frame additionally
 carries ``"trace"`` — the :class:`~keystone_tpu.obs.context.TraceContext`
@@ -113,6 +122,16 @@ def _registry():
     return {t.__name__: t for t in types}
 
 
+def _resolve_send_timeout() -> float:
+    """The steady-state send timeout: ``KEYSTONE_WIRE_SEND_TIMEOUT``
+    seconds (shared env accessor, warned once when unparsable), default
+    15s, floored at 0.1s — a zero timeout would turn every full kernel
+    buffer into an instant false death."""
+    from ..utils import env_float
+
+    return env_float("KEYSTONE_WIRE_SEND_TIMEOUT", 15.0, minimum=0.1)
+
+
 #: steady-state socket timeout both sides run with: a SEND that cannot
 #: make progress for this long means the peer stopped reading (wedged /
 #: SIGSTOPped / dead) and is treated as down — a blocking sendall with
@@ -120,16 +139,19 @@ def _registry():
 #: once the kernel buffer fills, unbounding the health loop and the
 #: documented bounded shutdown. RECEIVES simply keep waiting across
 #: timeouts (an idle connection is legitimate); only EOF/errors end them.
-SEND_TIMEOUT_S = 15.0
+#: Configurable via ``KEYSTONE_WIRE_SEND_TIMEOUT`` (read once at import,
+#: like every wire constant — both endpoint processes read their own
+#: environment, which the router's spawn path propagates).
+SEND_TIMEOUT_S = _resolve_send_timeout()
 
 
-def send_msg(sock: socket.socket, msg: Any) -> None:
-    """Write one framed message. Callers serialize access per socket
-    (the router's per-worker send lock / the worker's reply lock). A
-    ``socket.timeout`` from a full, unread buffer surfaces as
-    :class:`ConnectionClosed` — the peer has effectively left, and a
-    partially-sent frame has desynced the stream anyway."""
-    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+def send_payload(sock: socket.socket, payload: bytes) -> None:
+    """Write one length-framed, already-encoded payload. Callers
+    serialize access per socket (the router's per-worker send lock / the
+    worker's reply lock). A ``socket.timeout`` from a full, unread
+    buffer surfaces as :class:`ConnectionClosed` — the peer has
+    effectively left, and a partially-sent frame has desynced the
+    stream anyway."""
     try:
         sock.sendall(_LEN.pack(len(payload)) + payload)
     except socket.timeout as e:
@@ -138,10 +160,65 @@ def send_msg(sock: socket.socket, msg: Any) -> None:
         ) from e
 
 
-def recv_msg(sock: socket.socket, deadline: Optional[float] = None) -> Any:
-    """Read exactly one framed message; :class:`ConnectionClosed` on
-    EOF or a torn frame. Socket timeouts while WAITING for a frame are
-    not errors (idle peer) — the wait continues, unless ``deadline``
+def send_msg(sock: socket.socket, msg: Any) -> None:
+    """Write one framed CONTROL message (pickle). Hot-path senders
+    encode explicitly (:func:`encode_msg`) and use :func:`send_payload`
+    so encode time is attributable per frame."""
+    send_payload(
+        sock, pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    )
+
+
+def encode_msg(
+    msg: Any,
+    codec: str = "pickle",
+    shm=None,
+    min_shm_bytes: int = 1 << 16,
+    metrics=None,
+) -> bytes:
+    """One message as frame payload bytes. ``codec="binary"`` attempts
+    the hot codec for member-list ``req``/``res`` dicts (with ``shm`` as
+    this direction's TX ring) and falls back to pickle whenever the
+    frame is not binary-describable — the receiver dispatches on the
+    first payload byte, so the fallback needs no signalling."""
+    if codec == "binary":
+        from . import codec as codec_mod
+
+        try:
+            payload = codec_mod.encode(
+                msg, shm=shm, min_shm_bytes=min_shm_bytes, metrics=metrics
+            )
+        except Exception:
+            logging.getLogger(__name__).debug(
+                "binary encode failed; falling back to pickle",
+                exc_info=True,
+            )
+            payload = None
+        if payload is not None:
+            return payload
+    return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_payload(payload: bytes, shm=None, copy: bool = True) -> Any:
+    """One frame payload back into its message, dispatching per frame on
+    the leading byte: the binary magic routes to :mod:`.codec` (which
+    raises its typed :class:`~keystone_tpu.cluster.codec.CodecError` on
+    any malformed frame — binary bytes are NEVER unpickled), anything
+    else is a pickle control frame."""
+    if payload[:1] and payload[0] != 0x80:
+        from . import codec as codec_mod
+
+        if payload[0] == codec_mod.MAGIC:
+            return codec_mod.decode(payload, shm=shm, copy=copy)
+    return pickle.loads(payload)
+
+
+def recv_payload(
+    sock: socket.socket, deadline: Optional[float] = None
+) -> bytes:
+    """Read exactly one frame's payload bytes; :class:`ConnectionClosed`
+    on EOF or a torn frame. Socket timeouts while WAITING for a frame
+    are not errors (idle peer) — the wait continues, unless ``deadline``
     (a ``time.monotonic()`` stamp; the handshake path) passes first."""
     header = _recv_exact(sock, _LEN.size, deadline)
     (n,) = _LEN.unpack(header)
@@ -149,7 +226,20 @@ def recv_msg(sock: socket.socket, deadline: Optional[float] = None) -> Any:
         raise ConnectionClosed(
             f"frame length {n} exceeds {MAX_FRAME_BYTES} — desynced stream"
         )
-    return pickle.loads(_recv_exact(sock, n, deadline))
+    return _recv_exact(sock, n, deadline)
+
+
+def recv_msg(
+    sock: socket.socket,
+    deadline: Optional[float] = None,
+    shm=None,
+    copy: bool = True,
+) -> Any:
+    """Read + decode exactly one framed message (see
+    :func:`recv_payload` / :func:`decode_payload`)."""
+    return decode_payload(
+        recv_payload(sock, deadline), shm=shm, copy=copy
+    )
 
 
 def _recv_exact(

@@ -39,6 +39,7 @@ import signal
 import socket
 import sys
 import threading
+import time
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
@@ -66,7 +67,7 @@ def resolve_model(model_spec: Any):
     if kind == "pickle":
         import pickle
 
-        return pickle.loads(model_spec[1])
+        return pickle.loads(model_spec[1])  # lint: allow-pickle -- explicit model artifact from the router's boot spec
     raise ValueError(f"unknown model spec kind {kind!r}")
 
 
@@ -109,14 +110,17 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     from ..obs.export import wire_spans
     from ..obs.span import Span
     from ..serving import ServingFleet
+    from ..utils import env_int
     from .wire import (
         ConnectionClosed,
         costs_to_wire,
         deadline_from_wire,
+        decode_payload,
         encode_error,
+        encode_msg,
         qos_from_wire,
-        recv_msg,
-        send_msg,
+        recv_payload,
+        send_payload,
     )
 
     from .wire import SEND_TIMEOUT_S
@@ -149,18 +153,63 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
                     out.setdefault(tenant, {})[priority] = delta
         return out
 
+    # the hot-wire negotiation: the router's spec names the codec it
+    # will SEND (and expects back) and, when same-host zero-copy is on,
+    # the shared-memory ring pair this worker should attach. An attach
+    # failure is a negotiation answer, not an error: the ready report
+    # says shm=false and everything stays inline.
+    reply_codec = (
+        "binary"
+        if (spec.get("wire") or {}).get("codec") == "binary"
+        else "pickle"
+    )
+    shm_min_bytes = env_int("KEYSTONE_SHM_MIN_BYTES", 1 << 16, minimum=1)
+    shm_rx = shm_tx = None
+    shm_cfg = spec.get("shm")
+    if shm_cfg and reply_codec == "binary":
+        from .shm import ShmRing
+
+        try:
+            shm_rx = ShmRing(
+                shm_cfg["c2w"], shm_cfg["slots"], shm_cfg["slot_bytes"]
+            )
+            shm_tx = ShmRing(
+                shm_cfg["w2c"], shm_cfg["slots"], shm_cfg["slot_bytes"]
+            )
+        except Exception:
+            logger.warning(
+                "worker %d: shared-memory attach failed — wire payloads "
+                "stay inline", worker_id, exc_info=True,
+            )
+            if shm_rx is not None:
+                shm_rx.close()
+            shm_rx = shm_tx = None
+
     sock = socket.create_connection((host, port), timeout=30.0)
     # bounded sends, timeout-tolerant receives (see wire.SEND_TIMEOUT_S)
     sock.settimeout(SEND_TIMEOUT_S)
     send_lock = threading.Lock()
+    # control replies go out before the fleet (and its registry) exists;
+    # the wire counters attach once it does
+    metrics_ref: list = [None]
 
     def reply(msg: dict) -> None:
+        # control frames: always pickle, any dict shape
+        payload = encode_msg(msg)
         with send_lock:
-            send_msg(sock, msg)
+            send_payload(sock, payload)
+        m = metrics_ref[0]
+        if m is not None:
+            kind = msg.get("type")
+            m.inc(f"wire.frames.{kind}")
+            m.inc(f"wire.bytes_sent.{kind}", len(payload))
 
     reply({
         "type": "hello", "token": token, "worker": worker_id,
         "pid": os.getpid(),
+        # codec capability advertisement: the router sends binary hot
+        # frames only to peers that claim at least this version
+        "codec": 1,
     })
 
     fitted = resolve_model(spec["model"])
@@ -186,6 +235,7 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
         tenant_weights=spec.get("tenant_weights"),
     )
     fleet.start(warmup=spec.get("warmup"))
+    metrics_ref[0] = fleet.metrics
     snap = fleet.metrics.snapshot()
     reply({
         "type": "ready",
@@ -195,6 +245,9 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
         "capacity": fleet.n_replicas * fleet.policy.max_size,
         "replicas": fleet.n_replicas,
         "devices": [str(d) for d in devices],
+        # the shm negotiation's closing answer: true means both rings
+        # attached and zero-copy payloads are live on this connection
+        "shm": shm_rx is not None,
     })
     logger.info(
         "worker %d ready: %d replica(s) on %s (compiles=%d aot_loads=%d)",
@@ -230,38 +283,102 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     except ValueError:
         pass  # non-main thread (embedded use): router stop still works
 
-    def _answer(req_id: int, fut, ctx=None, t_recv_pc=None,
-                transport_s=None) -> None:
-        import time as _time
+    class _ReplyGroup:
+        """One coalesced request frame's answer aggregator: members
+        settle out of order on replica threads, ONE reply frame goes
+        back when the last lands, and only then are the request frame's
+        shm slots freed — reply receipt is the ring's reclamation
+        signal, so a slot is never reused while its datum may still be
+        read."""
 
+        def __init__(self, n: int, legacy: bool, req_shm_slots):
+            self._lock = threading.Lock()
+            self._remaining = n
+            self.members: list = [None] * n
+            self.legacy = legacy
+            self.req_shm_slots = tuple(req_shm_slots or ())
+            #: first traced member's id — the reply-side wire.encode
+            #: span hangs off it
+            self.traced_id: Optional[str] = None
+
+        def settle(self, pos: int, member: dict) -> None:
+            with self._lock:
+                self.members[pos] = member
+                self._remaining -= 1
+                done = self._remaining == 0
+            if done:
+                _send_res(self)
+
+    def _send_res(group: "_ReplyGroup") -> None:
+        # t_unix lets the router price the REPLY hop's transport (unix
+        # clocks are host-shared; monotonic ones are not)
+        t_unix = time.time()
+        t0 = t1 = 0.0
         try:
-            value = fut.result()
-            # t_unix lets the router price the REPLY hop's transport
-            # (unix clocks are host-shared; monotonic ones are not)
-            reply({
-                "type": "res", "id": req_id, "ok": True, "value": value,
-                "t_unix": _time.time(),
-            })
-        except BaseException as e:  # noqa: BLE001 — typed over the wire
-            try:
-                reply({
-                    "type": "res", "id": req_id, "ok": False,
-                    "error": encode_error(e), "t_unix": _time.time(),
-                })
-            except Exception:
-                # router gone; its death handling requeues
-                logger.debug(
-                    "reply for request %d undeliverable", req_id,
-                    exc_info=True,
+            if group.legacy:
+                # a legacy single-request frame gets the legacy reply
+                # shape — old routers never see member lists
+                msg = dict(group.members[0])
+                msg["type"] = "res"
+                msg["t_unix"] = t_unix
+                payload = encode_msg(msg)
+            else:
+                t0 = time.perf_counter()
+                payload = encode_msg(
+                    {
+                        "type": "res",
+                        "members": group.members,
+                        "t_unix": t_unix,
+                    },
+                    codec=reply_codec,
+                    shm=shm_tx,
+                    min_shm_bytes=shm_min_bytes,
+                    metrics=fleet.metrics,
                 )
+                t1 = time.perf_counter()
+            with send_lock:
+                send_payload(sock, payload)
+            fleet.metrics.inc("wire.frames.res")
+            fleet.metrics.inc("wire.bytes_sent.res", len(payload))
+            if (
+                group.traced_id is not None and tracer is not None
+                and not group.legacy
+            ):
+                tracer.record_complete(Span(
+                    name="wire.encode", start=t0, end=t1,
+                    op_type="ClusterWorker",
+                    attrs={
+                        "trace_id": group.traced_id,
+                        "codec": reply_codec,
+                        "bytes": len(payload),
+                        "members": len(group.members),
+                    },
+                ))
+        except Exception:
+            # router gone; its death handling requeues
+            logger.debug(
+                "reply frame undeliverable (router gone?)", exc_info=True
+            )
+        finally:
+            if shm_rx is not None:
+                for s in group.req_shm_slots:
+                    shm_rx.free(s)
+
+    def _member_done(pos: int, req_id: int, fut, group, ctx=None,
+                     t_recv_pc=None, transport_s=None) -> None:
+        try:
+            member = {"id": req_id, "ok": True, "value": fut.result()}
+        except BaseException as e:  # noqa: BLE001 — typed over the wire
+            member = {"id": req_id, "ok": False, "error": encode_error(e)}
+        group.settle(pos, member)
         if ctx is not None and tracer is not None:
-            # the worker-residency hop: wire arrival -> reply sent,
+            # the worker-residency hop: wire arrival -> reply settled,
             # stitched under the request's cross-process identity with
             # the inbound transport it measured off the wire stamp
             tracer.record_complete(Span(
                 name="cluster.handle",
                 start=t_recv_pc,
-                end=_time.perf_counter(),
+                end=time.perf_counter(),
                 op_type="ClusterWorker",
                 attrs={
                     "trace_id": ctx.trace_id,
@@ -276,40 +393,72 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
     rc = 0
     try:
         while True:
-            msg = recv_msg(sock)
+            payload = recv_payload(sock)
+            t_dec0 = time.perf_counter()
+            # copy=False: member data may view shm ring slots directly —
+            # the fleet consumes each datum before its reply frees the
+            # slot, so the zero-copy view is safe for exactly that long
+            msg = decode_payload(payload, shm=shm_rx, copy=False)
+            t_recv_pc = time.perf_counter()
             kind = msg.get("type")
             if kind == "req":
-                req_id = msg["id"]
-                deadline = deadline_from_wire(msg.get("deadline_rem"))
-                ctx = TraceContext.from_wire(msg.get("trace"))
-                import time as _time
-
-                t_recv_pc = _time.perf_counter()
-                transport_s = (
-                    ctx.transport_seconds() if ctx is not None else None
+                members = msg.get("members")
+                legacy = members is None
+                if legacy:
+                    members = [msg]  # pre-coalescing router frame
+                group = _ReplyGroup(
+                    len(members), legacy, msg.get("_shm_slots")
                 )
-                try:
-                    timeout = (
-                        None if deadline is None
-                        else max(0.0, deadline - _time.monotonic())
+                for pos, m in enumerate(members):
+                    req_id = m["id"]
+                    deadline = deadline_from_wire(m.get("deadline_rem"))
+                    ctx = TraceContext.from_wire(m.get("trace"))
+                    transport_s = (
+                        ctx.transport_seconds() if ctx is not None
+                        else None
                     )
-                    priority, tenant = qos_from_wire(msg)
-                    fut = fleet.submit(
-                        msg["datum"], timeout=timeout, trace=ctx,
-                        priority=priority, tenant=tenant,
+                    if ctx is not None and group.traced_id is None:
+                        group.traced_id = ctx.trace_id
+                    try:
+                        timeout = (
+                            None if deadline is None
+                            else max(0.0, deadline - time.monotonic())
+                        )
+                        priority, tenant = qos_from_wire(m)
+                        # every member keeps its own QoS/deadline/trace
+                        # identity inside the fleet — coalescing shares
+                        # the FRAME, never the scheduling class
+                        fut = fleet.submit(
+                            m["datum"], timeout=timeout, trace=ctx,
+                            priority=priority, tenant=tenant,
+                        )
+                    except BaseException as e:  # Shed/QueueFull typed back
+                        group.settle(pos, {
+                            "id": req_id, "ok": False,
+                            "error": encode_error(e),
+                        })
+                        continue
+                    fut.add_done_callback(
+                        lambda f, p=pos, rid=req_id, g=group, c=ctx,
+                        t=t_recv_pc, tr=transport_s: _member_done(
+                            p, rid, f, g, ctx=c, t_recv_pc=t,
+                            transport_s=tr,
+                        )
                     )
-                except BaseException as e:  # Shed/QueueFull/... typed back
-                    reply({
-                        "type": "res", "id": req_id, "ok": False,
-                        "error": encode_error(e), "t_unix": _time.time(),
-                    })
-                    continue
-                fut.add_done_callback(
-                    lambda f, rid=req_id, c=ctx, t=t_recv_pc,
-                    tr=transport_s: _answer(
-                        rid, f, ctx=c, t_recv_pc=t, transport_s=tr
-                    )
-                )
+                if group.traced_id is not None and tracer is not None:
+                    tracer.record_complete(Span(
+                        name="wire.decode", start=t_dec0, end=t_recv_pc,
+                        op_type="ClusterWorker",
+                        attrs={
+                            "trace_id": group.traced_id,
+                            "codec": (
+                                "pickle" if payload[:1] == b"\x80"
+                                else "binary"
+                            ),
+                            "bytes": len(payload),
+                            "members": len(members),
+                        },
+                    ))
             elif kind == "ping":
                 # the router's health cadence doubles as the worker's
                 # metrics-timeline sampler: one row per ping
@@ -387,6 +536,11 @@ def worker_main(host: str, port: int, token: str, worker_id: int,
             sock.close()
         except OSError:
             pass
+        # drop the shm mappings (the ROUTER owns unlink; a worker only
+        # ever attaches)
+        for ring in (shm_rx, shm_tx):
+            if ring is not None:
+                ring.close()
     return rc
 
 
@@ -396,7 +550,7 @@ def main(argv=None) -> int:  # pragma: no cover - exercised via spawn
     import pickle
 
     host, port, token, worker_id = argv or sys.argv[1:5]
-    spec = pickle.load(sys.stdin.buffer)
+    spec = pickle.load(sys.stdin.buffer)  # lint: allow-pickle -- boot spec from the parent router's stdin pipe
     return worker_main(host, int(port), token, int(worker_id), spec)
 
 

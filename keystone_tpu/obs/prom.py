@@ -69,6 +69,13 @@ KNOWN_COUNTERS = (
     "canary_fail",
     "trainer_restarts",
     "trainer_crashes",
+    "wire.frames.",
+    "wire.bytes_sent.",
+    "coalesce.frames",
+    "coalesce.members",
+    "shm.payloads",
+    "shm.bytes",
+    "shm.fallback",
 )
 
 _HELP = {
@@ -80,6 +87,11 @@ _HELP = {
     "slo_breaches": "SLO objectives breached across all policies",
     "compiles": "cold pipeline traces paid",
     "aot_loads": "warm executable loads from the AOT cache",
+    "coalesce.frames": "coalesced multi-member wire frames sent",
+    "coalesce.members": "requests that rode a coalesced frame",
+    "shm.payloads": "wire payloads moved through shared-memory slots",
+    "shm.bytes": "payload bytes moved through shared-memory slots",
+    "shm.fallback": "payloads degraded inline (ring full/too large)",
 }
 
 #: dotted counter prefix -> (family suffix, label name)
@@ -87,6 +99,8 @@ _LABELED_FAMILIES = (
     ("tenant.served.", "tenant_served", "tenant"),
     ("slo_breach.", "slo_breach", "objective"),
     ("shed.", "shed_by_priority", "priority"),
+    ("wire.frames.", "wire_frames", "kind"),
+    ("wire.bytes_sent.", "wire_bytes_sent", "kind"),
 )
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")

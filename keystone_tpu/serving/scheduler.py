@@ -141,6 +141,41 @@ class ServiceEstimate:
             return 0.0
         return s * (1 + depth // max(int(capacity), 1))
 
+    #: fraction of one learned batch-service time a coalescer may spend
+    #: holding a partial frame open: small enough that the added wait
+    #: disappears inside the service time it amortizes against
+    COALESCE_FRACTION = 0.25
+
+    def coalesce_window(
+        self,
+        now: float,
+        tightest_deadline: Optional[float] = None,
+        cap: float = 0.002,
+    ) -> float:
+        """Max seconds the router's front-door coalescer may hold an
+        already-started frame open for more members — the same evidence
+        the shed path prices from, pointed at batching instead of
+        refusal. Three ceilings, all of them protective:
+
+        * a FRACTION of the learned batch-service EWMA (waiting longer
+          than the work itself takes can only hurt p99);
+        * ``cap`` — the operator's absolute bound (the router passes its
+          ``max_wait_ms``, the same knob that bounds worker-side batch
+          gathering);
+        * the tightest member deadline minus one service time — the
+          frame must still be SERVABLE for its most impatient member
+          when the window closes.
+
+        Zero before any evidence: a cold coalescer, like a cold shedder,
+        never delays traffic it cannot price."""
+        s = self._ewma
+        if s is None:
+            return 0.0
+        w = min(float(cap), self.COALESCE_FRACTION * s)
+        if tightest_deadline is not None:
+            w = min(w, tightest_deadline - now - s)
+        return max(0.0, w)
+
 
 class FleetScheduler:
     """Shared admission queue + per-replica run queues for N replicas."""

@@ -1,0 +1,194 @@
+"""Front-door coalescing tests: the priced wait window (unit), and the
+integration contract — coalesced members keep their individual identity
+(answers, deadlines, QoS, requeue-on-worker-death) while sharing frames.
+
+One module-scoped coalescing router serves the integration tests (worker
+boots pay a fresh interpreter + jax import each); tests run in
+definition order and are sequenced so state they leave behind — warmed
+estimates, a killed worker — never invalidates a later assertion.
+"""
+
+import os
+import signal
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from keystone_tpu.cluster import ClusterRouter
+from keystone_tpu.serving.scheduler import ServiceEstimate
+
+D = 32
+STALL_S = 0.004
+
+
+# ---------------------------------------------------------------------------
+# the priced window (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_cold_estimator_never_delays():
+    est = ServiceEstimate()
+    assert est.coalesce_window(now=100.0) == 0.0
+    assert est.coalesce_window(now=100.0, tightest_deadline=200.0) == 0.0
+
+
+def test_window_is_a_fraction_of_learned_service():
+    est = ServiceEstimate()
+    est.observe(0.004)
+    w = est.coalesce_window(now=0.0, cap=1.0)
+    assert w == pytest.approx(ServiceEstimate.COALESCE_FRACTION * 0.004)
+
+
+def test_operator_cap_bounds_the_window():
+    est = ServiceEstimate()
+    est.observe(10.0)  # enormous service time
+    assert est.coalesce_window(now=0.0, cap=0.002) == 0.002
+
+
+def test_tight_deadline_shrinks_then_zeroes_the_window():
+    est = ServiceEstimate()
+    est.observe(0.01)
+    now = 50.0
+    # frame must still be servable: deadline - now - one service time
+    w = est.coalesce_window(now, tightest_deadline=now + 0.011, cap=1.0)
+    assert w == pytest.approx(0.001)
+    # an unmeetable member means the frame goes NOW, not never
+    assert est.coalesce_window(now, tightest_deadline=now + 0.005) == 0.0
+    assert est.coalesce_window(now, tightest_deadline=now - 1.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# integration: identity through shared frames (and worker death)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def router():
+    r = ClusterRouter(
+        ("factory", "keystone_tpu.cluster.demo:build_stall_model",
+         {"d": D, "stall_s": STALL_S}),
+        workers=2,
+        replicas_per_worker=1,
+        buckets=(16,),
+        datum_shape=(D,),
+        max_wait_ms=2.0,
+        spawn_timeout_s=180,
+        health_interval_s=3600.0,
+        drain_timeout_s=5.0,
+        join_timeout_s=2.0,
+        max_restarts=2,
+    )
+    r.start()
+    yield r
+    r.shutdown(drain=False)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return np.random.RandomState(3).randn(64, D).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def expected(data):
+    from keystone_tpu.cluster.demo import build_stall_model
+
+    local = build_stall_model(d=D, stall_s=0.0)
+    return np.asarray(local.apply(data).to_array())
+
+
+def test_a_concurrent_burst_coalesces_with_per_member_answers(
+    router, data, expected
+):
+    n = 48
+    with ThreadPoolExecutor(max_workers=n) as pool:
+        outs = list(pool.map(
+            lambda i: np.asarray(router.predict(data[i], timeout=60.0)),
+            range(n),
+        ))
+    # every member got ITS answer, not its frame-mates'
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out, expected[i], atol=1e-5)
+    c = router.snapshot()["counters"]
+    # the burst shared frames: strictly fewer req frames than requests
+    assert 0 < c["wire.frames.req"] < n, c
+    assert c["coalesce.frames"] >= 1, c
+    assert c["coalesce.members"] > c["coalesce.frames"], c
+    assert c["wire.bytes_sent.req"] > 0, c
+
+
+def test_b_lone_request_dispatches_without_waiting(router, data):
+    # quiet router + a warmed estimate: a single request must not sit
+    # out a coalescing window it can never fill
+    router.observe_service(0.5)  # window would be ~max_wait_ms if waited
+    t0 = time.monotonic()
+    router.predict(data[0], timeout=30.0)
+    # far below the 125ms a COALESCE_FRACTION * 0.5s wait would cost
+    assert time.monotonic() - t0 < 0.4
+    router.observe_service(STALL_S)  # re-seed something sane
+
+
+def test_c_members_requeue_individually_on_worker_death(
+    router, data, expected
+):
+    """SIGKILL a worker with coalesced frames in flight: every member of
+    its frames must be re-placed individually (deadline/QoS/trace
+    intact) and answer with ITS result — zero admitted failures."""
+    before = router.snapshot()["counters"]
+    victim = router.worker_pids[0]
+    n = 96
+    # SIGSTOP first: the victim's share of the burst piles up outstanding
+    # (it can neither answer nor close its socket), so the later SIGKILL
+    # is GUARANTEED to strand coalesced members in flight
+    os.kill(victim, signal.SIGSTOP)
+    try:
+        with ThreadPoolExecutor(max_workers=24) as pool:
+
+            def one(i):
+                return np.asarray(
+                    router.predict(data[i % 64], timeout=120.0)
+                )
+
+            futs = [pool.submit(one, i) for i in range(n)]
+            time.sleep(0.3)  # let frames land on the stopped victim
+            os.kill(victim, signal.SIGKILL)
+            outs = [f.result(timeout=120) for f in futs]
+    finally:
+        try:
+            os.kill(victim, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out, expected[i % 64], atol=1e-5)
+    after = router.snapshot()["counters"]
+    assert after["restarts"] >= before.get("restarts", 0) + 1
+    # the kill stranded at least one coalesced frame's members
+    assert after["requeues"] > before.get("requeues", 0), after
+    assert after["coalesce.frames"] > before.get("coalesce.frames", 0)
+    # the respawned worker rejoins (fresh interpreter: generous budget)
+    deadline = time.monotonic() + 120
+    while router.live_workers < 2 and time.monotonic() < deadline:
+        time.sleep(0.25)
+    assert router.live_workers == 2, "killed worker was not respawned"
+
+
+def test_d_coalescing_off_is_frame_per_request(data):
+    r = ClusterRouter(
+        ("factory", "keystone_tpu.cluster.demo:build_stall_model",
+         {"d": D, "stall_s": 0.0}),
+        workers=1,
+        replicas_per_worker=1,
+        buckets=(8,),
+        datum_shape=(D,),
+        max_wait_ms=1.0,
+        spawn_timeout_s=180,
+        health_interval_s=3600.0,
+        coalesce=False,
+    )
+    with r:
+        for i in range(6):
+            r.predict(data[i], timeout=30.0)
+        c = r.snapshot()["counters"]
+    assert c["wire.frames.req"] == 6, c
+    assert "coalesce.frames" not in c or c["coalesce.frames"] == 0, c

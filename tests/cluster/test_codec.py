@@ -1,0 +1,373 @@
+"""Binary hot-codec unit tests: round-trips over the whole dtype table,
+member identity (deadline/QoS/trace) preservation, shm slot placement,
+and — the security half — typed degradation on corrupt, truncated, or
+version-skewed frames with hot bytes NEVER reaching the unpickler.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from keystone_tpu.cluster import codec as codec_mod
+from keystone_tpu.cluster.codec import (
+    _CODE_TO_DTYPE,
+    MAGIC,
+    VERSION,
+    CodecError,
+    decode,
+    encode,
+)
+from keystone_tpu.cluster.shm import ShmRing
+from keystone_tpu.cluster.wire import ConnectionClosed, decode_payload
+
+
+class _Counters:
+    """Minimal metrics stand-in: the codec only calls ``inc``."""
+
+    def __init__(self):
+        self.counts = {}
+
+    def inc(self, name, n=1):
+        self.counts[name] = self.counts.get(name, 0) + n
+
+
+def _roundtrip(msg, **kw):
+    payload = encode(msg, **kw)
+    assert payload is not None, msg
+    assert payload[0] == MAGIC  # never a pickle frame
+    return decode(payload)
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "dtype", sorted(_CODE_TO_DTYPE.values(), key=str), ids=str
+)
+def test_req_round_trip_every_wire_dtype(dtype):
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 2, size=(3, 5)).astype(dtype)
+    got = _roundtrip({
+        "type": "req",
+        "members": [{"id": 11, "datum": arr, "deadline_rem": 0.25}],
+    })
+    assert got["type"] == "req" and len(got["members"]) == 1
+    m = got["members"][0]
+    assert m["id"] == 11 and m["deadline_rem"] == 0.25
+    assert m["datum"].dtype == dtype and m["datum"].shape == arr.shape
+    assert m["datum"].tobytes() == arr.tobytes()
+
+
+@pytest.mark.parametrize(
+    "arr",
+    [
+        np.float32(3.5) * np.ones(()),  # 0-d
+        np.zeros((0,), np.float64),  # empty 1-d
+        np.zeros((4, 0, 2), np.int32),  # empty with interior zero dim
+        np.arange(24, dtype=np.int16).reshape(2, 3, 4),
+    ],
+    ids=["zero-d", "empty", "zero-dim", "three-d"],
+)
+def test_req_round_trip_shapes(arr):
+    m = _roundtrip({"type": "req", "members": [{"id": 1, "datum": arr}]})[
+        "members"
+    ][0]
+    np.testing.assert_array_equal(m["datum"], arr)
+    assert m["datum"].shape == arr.shape and m["datum"].dtype == arr.dtype
+
+
+def test_req_members_keep_individual_identity():
+    members = [
+        {
+            "id": 1,
+            "datum": np.ones((2,), np.float32),
+            "deadline_rem": 0.5,
+            "priority": "high",
+            "tenant": "acme",
+            "trace": {"id": "t-1", "hop": "router", "sent_unix": 123.5},
+        },
+        {"id": 2, "datum": np.zeros((2,), np.float32)},
+        {
+            "id": 3,
+            "datum": np.full((2,), 7, np.float32),
+            "priority": "low",
+            "tenant": "beta",
+        },
+    ]
+    got = _roundtrip({"type": "req", "members": members})["members"]
+    assert [m["id"] for m in got] == [1, 2, 3]
+    assert got[0]["priority"] == "high" and got[0]["tenant"] == "acme"
+    assert got[0]["trace"] == {
+        "id": "t-1", "hop": "router", "sent_unix": 123.5,
+    }
+    # member 2 shipped defaults: no spurious keys materialize
+    assert "priority" not in got[1] and "tenant" not in got[1]
+    assert "deadline_rem" not in got[1] and "trace" not in got[1]
+    assert got[2]["priority"] == "low" and got[2]["tenant"] == "beta"
+
+
+def test_res_round_trip_values_and_typed_errors():
+    msg = {
+        "type": "res",
+        "t_unix": 1700000000.25,
+        "members": [
+            {"id": 5, "ok": True, "value": np.arange(4, dtype=np.float64)},
+            {
+                "id": 6,
+                "ok": False,
+                "error": {"kind": "Shed", "message": "late"},
+            },
+            {
+                "id": 7,
+                "ok": False,
+                "error": {
+                    "kind": "WorkerError",
+                    "message": "odd",
+                    "original": "Weird",
+                },
+            },
+        ],
+    }
+    got = _roundtrip(msg)
+    assert got["t_unix"] == msg["t_unix"]
+    ok, shed, weird = got["members"]
+    np.testing.assert_array_equal(ok["value"], np.arange(4, dtype=np.float64))
+    assert shed == {
+        "id": 6, "ok": False,
+        "error": {"kind": "Shed", "message": "late"},
+    }
+    assert weird["error"]["original"] == "Weird"
+
+
+def test_non_describable_frames_return_none():
+    # object arrays, unknown priorities, non-array payloads, foreign
+    # frame types: all fall back to the pickle path (None), never raise
+    assert encode({"type": "req", "members": [
+        {"id": 1, "datum": np.array([object()])},
+    ]}) is None
+    assert encode({"type": "req", "members": [
+        {"id": 1, "datum": np.ones(2, np.float32), "priority": "vip"},
+    ]}) is None
+    assert encode({"type": "req", "members": [{"id": 1, "datum": 3.5}]}) \
+        is None
+    assert encode({"type": "res", "members": [
+        {"id": 1, "ok": True, "value": "not an array"},
+    ]}) is None
+    assert encode({"type": "res", "members": [
+        {"id": 1, "ok": False, "error": "not a dict"},
+    ]}) is None
+    assert encode({"type": "hello"}) is None
+
+
+def test_non_contiguous_input_round_trips():
+    base = np.arange(24, dtype=np.float32).reshape(4, 6)
+    view = base[:, ::2]  # non-contiguous
+    m = _roundtrip({"type": "req", "members": [{"id": 1, "datum": view}]})[
+        "members"
+    ][0]
+    np.testing.assert_array_equal(m["datum"], view)
+
+
+# ---------------------------------------------------------------------------
+# typed degradation: corrupt / truncated / version skew, never unpickled
+# ---------------------------------------------------------------------------
+
+
+def _valid_req_payload():
+    payload = encode({
+        "type": "req",
+        "members": [{"id": 9, "datum": np.arange(8, dtype=np.float32)}],
+    })
+    assert payload is not None
+    return payload
+
+
+def test_truncated_frame_degrades_typed():
+    payload = _valid_req_payload()
+    for cut in (1, 3, len(payload) // 2, len(payload) - 1):
+        with pytest.raises(CodecError):
+            decode(payload[:cut])
+
+
+def test_trailing_bytes_degrade_typed():
+    with pytest.raises(CodecError, match="trailing"):
+        decode(_valid_req_payload() + b"\x00")
+
+
+def test_version_skew_degrades_typed():
+    payload = bytearray(_valid_req_payload())
+    payload[1] = VERSION + 1
+    with pytest.raises(CodecError, match="version skew"):
+        decode(bytes(payload))
+
+
+def test_corrupt_fields_degrade_typed():
+    base = _valid_req_payload()
+    # header(6) + member id(8) + flags(1) + priority(1) + tenant len(4)
+    dtype_code_off = 6 + 8 + 1 + 1 + 4
+    for offset, value in [
+        (2, 99),  # unknown frame kind
+        (dtype_code_off, 200),  # unknown dtype code
+        (dtype_code_off + 1, 40),  # ndim past _MAX_NDIM
+    ]:
+        payload = bytearray(base)
+        payload[offset] = value
+        with pytest.raises(CodecError):
+            decode(bytes(payload))
+
+
+def test_codec_error_is_connection_closed():
+    # the supervision contract: a desynced hot stream is handled exactly
+    # like a dead peer — requeue on peers, typed
+    assert issubclass(CodecError, ConnectionClosed)
+    with pytest.raises(ConnectionClosed):
+        decode(b"\xb5garbage")
+
+
+def test_binary_bytes_never_reach_the_unpickler(monkeypatch):
+    """A malformed MAGIC-led payload must raise CodecError out of
+    decode_payload without pickle.loads ever being consulted."""
+    calls = []
+    real_loads = pickle.loads
+
+    def spy(data, *a, **kw):
+        calls.append(data[:1])
+        return real_loads(data, *a, **kw)
+
+    monkeypatch.setattr(
+        "keystone_tpu.cluster.wire.pickle.loads", spy
+    )
+    evil = bytes([MAGIC]) + b"\x00" * 32  # version 0 -> skew
+    with pytest.raises(CodecError):
+        decode_payload(evil)
+    assert calls == [], "binary payload was handed to pickle.loads"
+    # while a genuine pickle control frame still decodes
+    assert decode_payload(pickle.dumps({"type": "ping"})) == {"type": "ping"}
+    assert calls, "control frame bypassed the (spied) unpickler"
+
+
+def test_magic_collides_with_no_pickle_protocol():
+    # protocol >= 2 pickles open with 0x80; the magic must differ so the
+    # per-frame dispatch in decode_payload is unambiguous
+    for proto in range(2, pickle.HIGHEST_PROTOCOL + 1):
+        assert pickle.dumps({"x": 1}, protocol=proto)[0] == 0x80
+    assert MAGIC != 0x80
+
+
+# ---------------------------------------------------------------------------
+# shm placement
+# ---------------------------------------------------------------------------
+
+
+def _ring(name, slots=2, slot_bytes=1 << 12):
+    return ShmRing(name, slots, slot_bytes, create=True)
+
+
+def test_shm_placement_and_copying_decode_frees_slots():
+    ring = _ring("kstcodec1")
+    try:
+        metrics = _Counters()
+        arr = np.arange(256, dtype=np.float32)  # 1 KiB >= threshold
+        payload = encode(
+            {"type": "req", "members": [{"id": 1, "datum": arr}]},
+            shm=ring, min_shm_bytes=1024, metrics=metrics,
+        )
+        assert metrics.counts["shm.payloads"] == 1
+        assert metrics.counts["shm.bytes"] == arr.nbytes
+        assert ring.in_use == 1
+        # frame carries only the descriptor, not the array bytes
+        assert len(payload) < arr.nbytes
+        got = decode(payload, shm=ring, copy=True)
+        np.testing.assert_array_equal(got["members"][0]["datum"], arr)
+        assert "_shm_slots" not in got  # copied out: freed immediately
+        assert ring.in_use == 0
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_shm_zero_copy_decode_defers_slot_free():
+    ring = _ring("kstcodec2")
+    try:
+        arr = np.arange(512, dtype=np.float64)
+        payload = encode(
+            {"type": "req", "members": [{"id": 1, "datum": arr}]},
+            shm=ring, min_shm_bytes=1024,
+        )
+        got = decode(payload, shm=ring, copy=False)
+        slots = got.pop("_shm_slots")
+        datum = got["members"][0]["datum"]
+        np.testing.assert_array_equal(datum, arr)
+        assert len(slots) == 1 and ring.in_use == 1
+        del got, datum  # release the zero-copy view before reclaiming
+        for s in slots:
+            ring.free(s)
+        assert ring.in_use == 0
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_shm_exhaustion_degrades_inline_and_counts():
+    ring = _ring("kstcodec3", slots=1)
+    try:
+        metrics = _Counters()
+        arrs = [np.arange(512, dtype=np.float32) + i for i in range(3)]
+        payload = encode(
+            {
+                "type": "req",
+                "members": [
+                    {"id": i, "datum": a} for i, a in enumerate(arrs)
+                ],
+            },
+            shm=ring, min_shm_bytes=1024, metrics=metrics,
+        )
+        assert metrics.counts["shm.payloads"] == 1
+        assert metrics.counts["shm.fallback"] == 2
+        got = decode(payload, shm=ring, copy=True)
+        for m, a in zip(got["members"], arrs):
+            np.testing.assert_array_equal(m["datum"], a)  # bit-equal both ways
+        assert ring.in_use == 0
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_small_payloads_stay_inline():
+    ring = _ring("kstcodec4")
+    try:
+        metrics = _Counters()
+        payload = encode(
+            {"type": "req", "members": [
+                {"id": 1, "datum": np.ones(4, np.float32)},
+            ]},
+            shm=ring, min_shm_bytes=1024, metrics=metrics,
+        )
+        assert ring.in_use == 0 and not metrics.counts
+        # an inline frame decodes without any ring attached
+        got = decode(payload, shm=None, copy=True)
+        np.testing.assert_array_equal(
+            got["members"][0]["datum"], np.ones(4, np.float32)
+        )
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_shm_reference_without_ring_degrades_typed():
+    ring = _ring("kstcodec5")
+    try:
+        payload = encode(
+            {"type": "req", "members": [
+                {"id": 1, "datum": np.arange(512, dtype=np.float32)},
+            ]},
+            shm=ring, min_shm_bytes=1024,
+        )
+        with pytest.raises(CodecError, match="no ring"):
+            decode(payload, shm=None)
+    finally:
+        ring.close()
+        ring.unlink()

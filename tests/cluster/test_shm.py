@@ -1,0 +1,125 @@
+"""Shared-memory ring unit tests: slot lifecycle, exhaustion, bounds,
+cross-mapping visibility, and creator-owned unlink semantics.
+"""
+
+from multiprocessing import resource_tracker
+
+import numpy as np
+import pytest
+
+from keystone_tpu.cluster.codec import CodecError
+from keystone_tpu.cluster.shm import ShmRing, make_ring_pair
+
+
+def _attach(name, slots, slot_bytes):
+    """Attach a second mapping in THIS process. In production the
+    attacher is a different process, so dropping its tracker claim
+    (ShmRing's 3.10 double-unlink guard) is free; here creator and
+    attacher share one tracker, so restore the creator's claim to keep
+    the exit-time ledger balanced."""
+    ring = ShmRing(name, slots, slot_bytes, create=False)
+    resource_tracker.register(f"/{name}", "shared_memory")
+    return ring
+
+
+@pytest.fixture
+def ring():
+    r = ShmRing("kstshmtest", slots=3, slot_bytes=256, create=True)
+    yield r
+    r.close()
+    r.unlink()
+
+
+def test_alloc_write_view_free_cycle(ring):
+    data = bytes(range(200))
+    slot = ring.alloc(len(data))
+    assert slot is not None
+    ring.write(slot, data)
+    assert ring.in_use == 1
+    view = ring.view(slot, len(data))
+    assert bytes(view) == data
+    del view  # release the buffer before reclaiming
+    ring.free(slot)
+    assert ring.in_use == 0
+
+
+def test_exhaustion_returns_none_then_recovers(ring):
+    slots = [ring.alloc(10) for _ in range(3)]
+    assert all(s is not None for s in slots)
+    assert len(set(slots)) == 3  # distinct slots, no double-alloc
+    assert ring.alloc(10) is None  # full: caller degrades inline
+    ring.free(slots[1])
+    assert ring.alloc(10) == slots[1]  # freed slot is reusable
+
+
+def test_oversized_payload_returns_none(ring):
+    assert ring.alloc(257) is None  # bigger than any slot: inline
+    assert ring.alloc(256) is not None  # exactly a slot fits
+
+
+def test_out_of_range_descriptor_raises_codec_error(ring):
+    # a corrupt frame's slot descriptor must degrade typed, not index
+    # out of the segment
+    with pytest.raises(CodecError):
+        ring.view(7, 10)
+    with pytest.raises(CodecError):
+        ring.view(0, 1 << 20)
+    ring.free(99)  # out-of-range free is ignored, not an error
+
+
+def test_attached_mapping_sees_writers_bytes(ring):
+    # same-host second mapping (what the worker does with the spec's
+    # names): bytes written through one mapping are simply THERE in the
+    # other, and the state table is shared too
+    arr = np.arange(32, dtype=np.float64)
+    slot = ring.alloc(arr.nbytes)
+    ring.write(slot, memoryview(arr).cast("B"))
+    peer = _attach("kstshmtest", slots=3, slot_bytes=256)
+    try:
+        assert peer.in_use == 1
+        got = np.frombuffer(
+            bytes(peer.view(slot, arr.nbytes)), dtype=np.float64
+        )
+        np.testing.assert_array_equal(got, arr)
+        peer.free(slot)  # reader-side reclamation...
+        assert ring.in_use == 0  # ...visible to the creator
+    finally:
+        peer.close()
+
+
+def test_closed_ring_stops_allocating(ring):
+    ring.close()
+    assert ring.alloc(10) is None
+
+
+def test_unlink_is_creator_only_and_idempotent():
+    creator = ShmRing("kstshmunlink", slots=1, slot_bytes=64, create=True)
+    attached = _attach("kstshmunlink", slots=1, slot_bytes=64)
+    attached.close()
+    attached.unlink()  # attach side: a no-op, the segment survives
+    still = _attach("kstshmunlink", slots=1, slot_bytes=64)
+    still.close()
+    creator.close()
+    creator.unlink()
+    creator.unlink()  # idempotent
+    with pytest.raises(FileNotFoundError):
+        ShmRing("kstshmunlink", slots=1, slot_bytes=64, create=False)
+
+
+def test_make_ring_pair_creates_both_directions():
+    c2w, w2c = make_ring_pair("kstshmpair", slots=2, slot_bytes=128)
+    try:
+        assert c2w is not None and w2c is not None
+        assert c2w.name == "kstshmpairc" and w2c.name == "kstshmpairr"
+        assert c2w.alloc(64) is not None and w2c.alloc(64) is not None
+    finally:
+        for r in (c2w, w2c):
+            r.close()
+            r.unlink()
+
+
+def test_degenerate_geometry_rejected():
+    with pytest.raises(ValueError):
+        ShmRing("kstshmbad", slots=0, slot_bytes=64, create=True)
+    with pytest.raises(ValueError):
+        ShmRing("kstshmbad", slots=1, slot_bytes=0, create=True)

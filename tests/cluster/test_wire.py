@@ -16,6 +16,7 @@ from keystone_tpu.cluster.wire import (
     encode_error,
     recv_msg,
     send_msg,
+    send_payload,
 )
 from keystone_tpu.serving.errors import (
     DeadlineExceeded,
@@ -120,3 +121,34 @@ def test_unknown_error_degrades_to_worker_error():
     back = decode_error(encode_error(Weird("odd")))
     assert isinstance(back, WorkerError)
     assert "Weird" in str(back)
+
+
+def test_send_timeout_knob(monkeypatch):
+    from keystone_tpu.cluster.wire import _resolve_send_timeout
+
+    monkeypatch.delenv("KEYSTONE_WIRE_SEND_TIMEOUT", raising=False)
+    assert _resolve_send_timeout() == 15.0
+    monkeypatch.setenv("KEYSTONE_WIRE_SEND_TIMEOUT", "7.5")
+    assert _resolve_send_timeout() == 7.5
+    # floored: a zero timeout would turn every full kernel buffer into
+    # an instant false death
+    monkeypatch.setenv("KEYSTONE_WIRE_SEND_TIMEOUT", "0")
+    assert _resolve_send_timeout() == 0.1
+    # unparsable degrades to the default (env_float WARNs once)
+    monkeypatch.setenv("KEYSTONE_WIRE_SEND_TIMEOUT", "soon")
+    assert _resolve_send_timeout() == 15.0
+
+
+def test_stalled_send_degrades_typed():
+    # the peer stops reading: sendall must hit the socket timeout and
+    # surface as ConnectionClosed, not hold the send lock forever
+    a, b = _pair()
+    try:
+        a.settimeout(0.2)
+        chunk = b"\x00" * (1 << 20)
+        with pytest.raises(ConnectionClosed, match="stopped reading"):
+            for _ in range(256):  # far beyond any kernel buffer
+                send_payload(a, chunk)
+    finally:
+        a.close()
+        b.close()

@@ -416,6 +416,80 @@ def test_trees_without_the_export_plane_skip_rule5(tmp_path):
     assert _coverage(str(tmp_path)) == []
 
 
+# ---------------------------------------------------------------------------
+# rule 6: pickle containment in the cluster package
+# ---------------------------------------------------------------------------
+
+
+def test_pickle_in_cluster_module_flagged(tmp_path):
+    vs = _lint_source(tmp_path, """
+        import pickle
+
+        def handle(payload):
+            return pickle.loads(payload)
+    """, rel="keystone_tpu/cluster/router.py")
+    assert [v.rule for v in vs] == ["pickle-containment"]
+    vs = _lint_source(tmp_path, """
+        import pickle
+
+        def ship(msg):
+            return pickle.dumps(msg)
+    """, rel="keystone_tpu/cluster/worker.py")
+    assert [v.rule for v in vs] == ["pickle-containment"]
+
+
+def test_pickle_in_wire_py_exempt(tmp_path):
+    vs = _lint_source(tmp_path, """
+        import pickle
+
+        def encode(msg):
+            return pickle.dumps(msg)
+    """, rel="keystone_tpu/cluster/wire.py")
+    assert vs == []
+
+
+def test_pickle_outside_cluster_not_rule6s_business(tmp_path):
+    vs = _lint_source(tmp_path, """
+        import pickle
+
+        def save(obj):
+            return pickle.dumps(obj)
+    """, rel="keystone_tpu/serving/engine.py")
+    assert [v.rule for v in vs] == []
+
+
+def test_pickle_pragma_on_call_line_allows(tmp_path):
+    vs = _lint_source(tmp_path, """
+        import pickle
+
+        def ship_spec(spec):
+            # boot path, not wire-frame data
+            return pickle.dumps(spec)  # lint: allow-pickle -- boot spec
+    """, rel="keystone_tpu/cluster/router.py")
+    assert vs == []
+
+
+def test_pickle_pragma_without_justification_ignored(tmp_path):
+    vs = _lint_source(tmp_path, """
+        import pickle
+
+        def ship_spec(spec):
+            return pickle.dumps(spec)  # lint: allow-pickle
+    """, rel="keystone_tpu/cluster/router.py")
+    assert [v.rule for v in vs] == ["pickle-containment"]
+
+
+def test_pickle_pragma_on_wrong_line_ignored(tmp_path):
+    vs = _lint_source(tmp_path, """
+        import pickle
+
+        # lint: allow-pickle -- the pragma must ride the CALL line
+        def ship_spec(spec):
+            return pickle.dumps(spec)
+    """, rel="keystone_tpu/cluster/router.py")
+    assert [v.rule for v in vs] == ["pickle-containment"]
+
+
 def test_violation_str_carries_location(tmp_path):
     vs = _lint_source(tmp_path, """
         try:

@@ -43,6 +43,16 @@ to fix by review more than once, plus the env-knob routing rule:
    ``..._counters["name"] += n`` augmented assignment. A scrape target or
    status line that can only ever read 0 is a dashboard lie.
 
+6. **Pickle stays off the cluster hot path.** Inside
+   ``keystone_tpu/cluster/``, ``pickle.dumps``/``loads`` (and
+   ``dump``/``load``) may only appear in ``wire.py`` — the one choke
+   point where control frames are encoded and a first-byte dispatch
+   keeps binary hot frames out of the unpickler. Anywhere else in the
+   cluster package a pickle call is either a hot-path regression or an
+   unreviewed deserialization surface; a legitimate boot-path use
+   (model spec shipping) carries the ``allow-pickle`` pragma naming why
+   it is not wire-frame data.
+
 Run as a script (``python tools/lint_invariants.py [root]``, exits 1 on
 violations) or via :func:`lint_tree` (the tier-1 test in
 ``tests/test_lint_invariants.py`` does the latter, so CI enforces all of
@@ -54,7 +64,8 @@ offending line::
     except Exception:  # lint: allow-silent -- <why this must stay quiet>
 
 Pragmas: ``allow-silent`` (rule 1), ``allow-env`` (rule 2),
-``allow-acquire`` (rule 3). Each requires a trailing justification.
+``allow-acquire`` (rule 3), ``allow-pickle`` (rule 6). Each requires a
+trailing justification.
 """
 
 from __future__ import annotations
@@ -78,6 +89,7 @@ _PRAGMAS = {
     "silent": "lint: allow-silent",
     "env": "lint: allow-env",
     "acquire": "lint: allow-acquire",
+    "pickle": "lint: allow-pickle",
 }
 
 
@@ -278,6 +290,45 @@ def _check_acquires(tree: ast.AST, path: str, pragmas: Dict[int, Set[str]]) -> I
             path, node.lineno, "bare-acquire",
             "bare .acquire() statement — hold the lock via `with lock:` "
             "so exceptions between acquire and release cannot leak it",
+        )
+
+
+# ---------------------------------------------------------------------------
+# rule 6: pickle containment in the cluster package
+# ---------------------------------------------------------------------------
+
+
+_PICKLE_CALLS = {"dumps", "loads", "dump", "load"}
+
+
+def _check_pickle_containment(
+    tree: ast.AST, path: str, rel: str, pragmas: Dict[int, Set[str]]
+) -> Iterator[Violation]:
+    rel_posix = rel.replace(os.sep, "/")
+    if "keystone_tpu/cluster/" not in rel_posix:
+        return
+    if rel_posix.endswith("/wire.py"):
+        return  # the one sanctioned choke point (first-byte dispatch)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr in _PICKLE_CALLS
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "pickle"
+        ):
+            continue
+        if "pickle" in pragmas.get(node.lineno, ()):
+            continue
+        yield Violation(
+            path, node.lineno, "pickle-containment",
+            f"pickle.{func.attr}() outside cluster/wire.py — hot frames "
+            "ride the binary codec and control frames go through wire's "
+            "encode/decode choke point; a boot-path use of pickle on "
+            "NON-frame data needs the `lint: allow-pickle -- <why>` "
+            "pragma",
         )
 
 
@@ -623,6 +674,7 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Violation]:
     out.extend(_check_excepts(tree, path, pragmas))
     out.extend(_check_env_reads(tree, path, rel, pragmas))
     out.extend(_check_acquires(tree, path, pragmas))
+    out.extend(_check_pickle_containment(tree, path, rel, pragmas))
     return out
 
 
